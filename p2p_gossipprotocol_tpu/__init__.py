@@ -1,0 +1,30 @@
+"""p2p_gossipprotocol_tpu — TPU-native gossip/epidemic-simulation framework.
+
+A brand-new framework with the capabilities of
+PareenShah27/P2P-GossipProtocol (C++ socket gossip; see SURVEY.md), rebuilt
+TPU-first: the peer overlay is a fixed-capacity edge set in HBM, rumor
+dissemination is a vectorized frontier propagation under ``lax.scan``, churn
+and liveness are alive-masks and missed-round counters, and the peer axis
+shards over a ``jax.sharding.Mesh``. A socket back-compat transport speaks
+the reference's JSON wire protocol for small-n interop.
+
+Layout:
+  config        — network.txt parser (reference config.cpp semantics)
+  info          — PeerInfo/Message data model + SHA-256 identity
+  graph         — overlay construction: power-law fanout, ER, BA generators
+  state         — simulation state pytrees
+  models/       — dissemination models: push flood, push-pull, SIR, Byzantine
+  ops/          — propagation primitives (edge OR-scatter, neighbor sampling)
+  parallel/     — mesh + sharded step (pjit/shard_map over the peer axis)
+  sim           — Simulator: scan loop, metrics, coverage
+  liveness      — churn schedules, 3-strike eviction, rewiring
+  transport/    — Transport interface; JAX and socket implementations
+  peer / seed   — socket-mode runtimes (asyncio)
+  wrapper       — Peer lifecycle facade; cli — ``peer_network`` entry point
+"""
+
+__version__ = "0.1.0"
+
+from p2p_gossipprotocol_tpu.config import ConfigError, NetworkConfig, NodeInfo
+
+__all__ = ["NetworkConfig", "NodeInfo", "ConfigError", "__version__"]

@@ -1,0 +1,306 @@
+"""Config / flag system.
+
+Parses the reference's ``network.txt`` format with identical rules
+(reference: config.cpp:53-143):
+
+* blank lines and ``#`` comments are skipped (config.cpp:64)
+* ``key=value`` lines set tuning params (config.cpp:93-96)
+* any other line must be ``ip:port`` — IPv4-validated via inet_pton
+  (config.cpp:103-115, 145-148), port in 1..65535 (config.cpp:150-152)
+* errors carry line numbers (config.cpp:66-70)
+* at least one seed required; quorum ``n // 2 + 1`` (config.cpp:73-76)
+* validation: positive params, no duplicate seeds (config.cpp:122-143)
+
+Fixes over the reference, per SURVEY.md §2-C3:
+
+* ``local_ip`` / ``local_port`` keys exist (the reference hard-codes
+  192.168.99.96:5000 for every process, config.cpp:38-39 — a port-collision
+  bug); defaults preserved for compat.
+* All parsed params are actually plumbed to the runtime (the reference
+  parses then ignores them, wrapper.cpp:10-14 vs peer.cpp:330,337,358,377).
+* Simulation keys for the JAX backend (backend, graph model, scale, mode,
+  churn, ...) — unknown keys are still silently ignored, matching the
+  reference's lenient key handling (config.cpp:93-96 has no else-clause).
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+from dataclasses import dataclass
+
+
+class ConfigError(Exception):
+    """Mirrors NetworkConfig::ConfigException (config.hpp:20-23)."""
+
+    def __init__(self, message: str):
+        super().__init__("Configuration Error: " + message)
+        self.message = message
+
+
+@dataclass(frozen=True)
+class NodeInfo:
+    """A seed/peer address. Equality ignores nothing — (ip, port) identity
+    (reference config.hpp:9-18)."""
+
+    ip: str = ""
+    port: int = 0
+
+    def to_string(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    def __str__(self) -> str:
+        return self.to_string()
+
+
+def is_valid_ip(ip: str) -> bool:
+    """IPv4 dotted-quad check, same acceptance set as inet_pton
+    (config.cpp:145-148): no leading-zero octets, exactly 4 octets."""
+    try:
+        socket.inet_pton(socket.AF_INET, ip)
+        return True
+    except (OSError, ValueError):
+        return False
+
+
+def is_valid_port(port: int) -> bool:
+    return 0 < port < 65536
+
+
+def _stoi(value: str) -> int:
+    """C++ std::stoi semantics: parse a leading integer, ignore trailing
+    junk, raise on no leading digits. The reference relies on stoi for both
+    params (config.cpp:93-96) and ports (config.cpp:108)."""
+    s = value.strip()
+    i = 0
+    if i < len(s) and s[i] in "+-":
+        i += 1
+    j = i
+    while j < len(s) and s[j].isdigit():
+        j += 1
+    if j == i:
+        raise ValueError(f"stoi: no conversion: {value!r}")
+    return int(s[:j])
+
+
+# Tuning params the reference parses (config.cpp:93-96), with its defaults
+# (config.cpp:31-39).
+_REFERENCE_INT_KEYS = {
+    "ping_interval": "ping_interval_secs",
+    "message_interval": "message_interval_secs",
+    "max_messages": "max_message_count",
+    "max_missed_pings": "max_missed_pings",
+}
+
+# New keys for the TPU-native backend. All optional.
+_SIM_INT_KEYS = {
+    "local_port": "local_port",
+    "n_peers": "n_peers",
+    "n_messages": "n_messages",
+    "avg_degree": "avg_degree",
+    "ba_m": "ba_m",
+    "fanout": "fanout",
+    "rounds": "rounds",
+    "prng_seed": "prng_seed",
+}
+_SIM_FLOAT_KEYS = {
+    "er_p": "er_p",
+    "churn_rate": "churn_rate",
+    "byzantine_fraction": "byzantine_fraction",
+    "powerlaw_alpha": "powerlaw_alpha",
+    "sir_beta": "sir_beta",
+    "sir_gamma": "sir_gamma",
+}
+_SIM_STR_KEYS = {
+    "local_ip": "local_ip",
+    "backend": "backend",
+    "graph": "graph",
+    "mode": "mode",
+}
+
+
+class NetworkConfig:
+    """Parsed network configuration (reference config.hpp:25-39)."""
+
+    def __init__(self, config_path: str):
+        self.config_file_path = config_path
+        self.seed_nodes = []
+        self.min_connection_count = 0
+        self.ping_interval_secs = 13
+        self.message_interval_secs = 5
+        self.max_message_count = 10
+        self.max_missed_pings = 3
+        self.local_ip = "192.168.99.96"
+        self.local_port = 5000
+        self.backend = "jax"
+        self.graph = "reference"
+        self.mode = "push"
+        self.n_peers = 0
+        self.n_messages = 0
+        self.avg_degree = 8
+        self.ba_m = 4
+        self.er_p = 0.0
+        self.fanout = 0
+        self.rounds = 0
+        self.churn_rate = 0.0
+        self.byzantine_fraction = 0.0
+        self.powerlaw_alpha = 2.5
+        self.sir_beta = 0.3
+        self.sir_gamma = 0.1
+        self.prng_seed = 0
+        self._load_config()
+        self._validate_config()
+
+    # -- getters kept for API parity with config.hpp:25-39 ----------------
+    def get_seed_nodes(self) -> list[NodeInfo]:
+        return self.seed_nodes
+
+    def get_local_ip(self) -> str:
+        return self.local_ip
+
+    def get_local_port(self) -> int:
+        return self.local_port
+
+    def get_min_required_seeds(self) -> int:
+        return self.min_connection_count
+
+    def get_ping_interval(self) -> int:
+        return self.ping_interval_secs
+
+    def get_message_interval(self) -> int:
+        return self.message_interval_secs
+
+    def get_max_messages(self) -> int:
+        return self.max_message_count
+
+    def get_max_missed_pings(self) -> int:
+        return self.max_missed_pings
+
+    # -- parsing ----------------------------------------------------------
+    def _load_config(self) -> None:
+        try:
+            with open(self.config_file_path, "r") as f:
+                lines = f.readlines()
+        except OSError:
+            raise ConfigError(
+                f"Unable to open config file: {self.config_file_path}"
+            )
+
+        for line_number, raw in enumerate(lines, start=1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                self._parse_line(line)
+            except ConfigError as e:
+                raise ConfigError(f"Error at line {line_number}: {e.message}")
+
+        if not self.seed_nodes:
+            raise ConfigError("No valid seed nodes found in configuration")
+        self.min_connection_count = len(self.seed_nodes) // 2 + 1
+
+    def _parse_line(self, line: str) -> None:
+        if "=" in line:
+            key, _, value = line.partition("=")
+            key = key.strip()
+            value = value.strip()
+            if not key or not value:
+                raise ConfigError("Invalid configuration format")
+            if key in _REFERENCE_INT_KEYS or key in _SIM_INT_KEYS:
+                attr = _REFERENCE_INT_KEYS.get(key) or _SIM_INT_KEYS[key]
+                try:
+                    setattr(self, attr, _stoi(value))
+                except ValueError:
+                    raise ConfigError(f"Invalid value for {key}: {value}")
+            elif key in _SIM_FLOAT_KEYS:
+                try:
+                    setattr(self, _SIM_FLOAT_KEYS[key], float(value))
+                except ValueError:
+                    raise ConfigError(f"Invalid value for {key}: {value}")
+            elif key in _SIM_STR_KEYS:
+                setattr(self, _SIM_STR_KEYS[key], value)
+            # unknown keys silently ignored (reference config.cpp:93-96)
+        else:
+            ip, sep, port_str = line.partition(":")
+            if not sep:
+                raise ConfigError("Invalid seed node format")
+            ip = ip.strip()
+            port_str = port_str.strip()
+            if not is_valid_ip(ip):
+                raise ConfigError(f"Invalid IP address: {ip}")
+            try:
+                port = _stoi(port_str)
+            except ValueError:
+                raise ConfigError(f"Invalid port format: {port_str}")
+            if not is_valid_port(port):
+                raise ConfigError(f"Invalid port number: {port_str}")
+            self.seed_nodes.append(NodeInfo(ip, port))
+
+    def _validate_config(self) -> None:
+        # Mirrors config.cpp:122-143.
+        if self.ping_interval_secs <= 0:
+            raise ConfigError("Ping interval must be positive")
+        if self.message_interval_secs <= 0:
+            raise ConfigError("Message interval must be positive")
+        if self.max_message_count <= 0:
+            raise ConfigError("Maximum message count must be positive")
+        if self.max_missed_pings <= 0:
+            raise ConfigError("Maximum missed pings must be positive")
+
+        for node in self.seed_nodes:
+            if not is_valid_ip(node.ip) or not is_valid_port(node.port):
+                raise ConfigError(
+                    f"Invalid seed node configuration: {node.to_string()}"
+                )
+
+        if len(set(self.seed_nodes)) != len(self.seed_nodes):
+            raise ConfigError("Duplicate seed nodes found in configuration")
+
+        # New-key sanity (not in the reference; fail fast instead of at
+        # graph-build or socket-bind time).
+        if not is_valid_ip(self.local_ip):
+            raise ConfigError(f"Invalid local_ip: {self.local_ip}")
+        if not is_valid_port(self.local_port):
+            raise ConfigError(f"Invalid local_port: {self.local_port}")
+        for k in ("n_peers", "n_messages", "avg_degree", "ba_m", "fanout",
+                  "rounds", "prng_seed"):
+            if getattr(self, k) < 0:
+                raise ConfigError(f"{k} must be non-negative")
+        if self.backend not in ("jax", "socket"):
+            raise ConfigError(f"Unknown backend: {self.backend}")
+        if self.graph not in ("reference", "er", "ba", "powerlaw"):
+            raise ConfigError(f"Unknown graph model: {self.graph}")
+        if self.mode not in ("push", "pull", "pushpull"):
+            raise ConfigError(f"Unknown gossip mode: {self.mode}")
+        if not (0.0 <= self.churn_rate < 1.0):
+            raise ConfigError("churn_rate must be in [0, 1)")
+        if not (0.0 <= self.byzantine_fraction < 1.0):
+            raise ConfigError("byzantine_fraction must be in [0, 1)")
+
+    # -- helpers ----------------------------------------------------------
+    def get_random_seeds(self, count: int, rng: random.Random | None = None
+                         ) -> list[NodeInfo]:
+        """Shuffled seed subset (reference config.cpp:154-165)."""
+        if count > len(self.seed_nodes):
+            raise ConfigError("Requested more seeds than available")
+        result = list(self.seed_nodes)
+        (rng or random).shuffle(result)
+        return result[:count]
+
+    def to_string(self) -> str:
+        """Mirrors config.cpp:167-182 (printed by main.cpp:48)."""
+        out = ["Network Configuration:", "----------------------",
+               f"Seed Nodes ({len(self.seed_nodes)}):"]
+        out += [f" {n.to_string()}" for n in self.seed_nodes]
+        out += [
+            f"Minimum Required Seeds: {self.min_connection_count}",
+            "Network Parameters:",
+            f" Ping Interval: {self.ping_interval_secs} seconds",
+            f" Message Interval: {self.message_interval_secs} seconds",
+            f" Max Messages: {self.max_message_count}",
+            f" Max Missed Pings: {self.max_missed_pings}",
+        ]
+        return "\n".join(out) + "\n"
+
+    def __str__(self) -> str:
+        return self.to_string()

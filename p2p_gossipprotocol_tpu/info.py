@@ -1,0 +1,98 @@
+"""Peer/message data model + message identity.
+
+Reference: info.hpp (PeerInfo + JSON serialization), peer.hpp:14-26
+(Message, MessageTracker), peer.cpp:135-159 (SHA-256 identity).
+
+Identity semantics preserved exactly: the hash covers content + timestamp +
+source IP only — NOT port or msg_number (reference peer.cpp:145-147; the
+SURVEY flags the same-host collision this allows, but it is observable
+behavior, so the socket transport keeps it; the JAX backend uses integer
+message ids and sidesteps string hashing entirely).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class PeerInfo:
+    """(ip, port, last_seen); equality/hash ignore last_seen
+    (reference info.hpp:11-19)."""
+
+    ip: str
+    port: int
+    last_seen: float = 0.0
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, PeerInfo)
+                and self.ip == other.ip and self.port == other.port)
+
+    def __hash__(self) -> int:
+        return hash(self.ip) ^ hash(self.port)
+
+    def to_json(self) -> dict:
+        # Wire shape from info.hpp:26-32: ip, port, lastSeen (time_t secs).
+        return {"ip": self.ip, "port": self.port,
+                "lastSeen": int(self.last_seen)}
+
+    @classmethod
+    def from_json(cls, j: dict) -> "PeerInfo":
+        return cls(j["ip"], int(j["port"]), float(j.get("lastSeen", 0)))
+
+    def now(self) -> "PeerInfo":
+        return PeerInfo(self.ip, self.port, time.time())
+
+
+@dataclass
+class Message:
+    """Gossip message (reference peer.hpp:14-21)."""
+
+    content: str
+    timestamp: str  # epoch-ns as string (peer.cpp:361)
+    source_ip: str
+    source_port: int
+    msg_number: int
+    hash: str = ""
+
+    def to_wire(self) -> dict:
+        # Field names from peer.cpp:299-305.
+        return {
+            "type": "gossip",
+            "content": self.content,
+            "timestamp": self.timestamp,
+            "source_ip": self.source_ip,
+            "source_port": self.source_port,
+            "msg_number": self.msg_number,
+            "hash": self.hash,
+        }
+
+    @classmethod
+    def from_wire(cls, j: dict) -> "Message":
+        return cls(j["content"], j["timestamp"], j["source_ip"],
+                   int(j["source_port"]), int(j["msg_number"]),
+                   j.get("hash", ""))
+
+
+def calculate_message_hash(msg: Message) -> str:
+    """SHA-256 hex over content+timestamp+sourceIP (peer.cpp:141-158).
+
+    Receivers recompute this rather than trusting the wire hash
+    (peer.cpp:277) — preserved in our socket runtime.
+    """
+    payload = f"{msg.content}{msg.timestamp}{msg.source_ip}".encode()
+    return hashlib.sha256(payload).hexdigest()
+
+
+@dataclass
+class MessageTracker:
+    """Message + the set of peers it was sent to (reference peer.hpp:23-26).
+
+    The reference populates sent_to but never reads it (SURVEY §2-C4);
+    we keep it because it makes send-exactly-once testable.
+    """
+
+    msg: Message
+    sent_to: set = field(default_factory=set)

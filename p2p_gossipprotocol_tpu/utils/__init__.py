@@ -1,0 +1,3 @@
+from p2p_gossipprotocol_tpu.utils.logging import NodeLogger
+
+__all__ = ["NodeLogger"]

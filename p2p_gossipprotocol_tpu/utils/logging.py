@@ -1,0 +1,38 @@
+"""Per-node append-only logs, as in the reference (peer.cpp:125-133,
+seed.cpp:180-188): one file per node role+port, each line timestamped.
+
+Adds what the reference lacks (SURVEY §5 observability): an optional
+structured JSONL stream alongside the human-readable lines.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from pathlib import Path
+
+
+class NodeLogger:
+    """``peer_<port>_output.txt`` / ``seed_<port>_output.txt`` writer.
+
+    Filenames match peer.cpp:21 / seed.cpp:18 so tooling written against
+    the reference's logs keeps working.
+    """
+
+    def __init__(self, role: str, port: int, directory: str | Path = ".",
+                 jsonl: bool = False):
+        self.path = Path(directory) / f"{role}_{port}_output.txt"
+        self.jsonl_path = (Path(directory) / f"{role}_{port}_events.jsonl"
+                           if jsonl else None)
+        self._lock = threading.Lock()
+
+    def log(self, message: str, **fields) -> None:
+        stamp = time.ctime()
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(f"{stamp}: {message}\n")
+            if self.jsonl_path is not None:
+                with open(self.jsonl_path, "a") as f:
+                    f.write(json.dumps(
+                        {"t": time.time(), "msg": message, **fields}) + "\n")

@@ -1,0 +1,28 @@
+"""Test harness config: force an 8-device virtual CPU mesh before JAX init.
+
+The reference has no tests at all (SURVEY.md §4); our strategy is seeded,
+deterministic single-process simulation — the multi-node-without-a-cluster
+fixture the reference lacks. Multi-chip sharding is exercised on 8 virtual
+CPU devices (driver separately dry-runs the real multi-chip path).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402  (import after env setup)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs[:8]

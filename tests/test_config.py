@@ -1,0 +1,197 @@
+"""Config parser tests — one per rule in reference config.cpp:53-143."""
+
+import pytest
+
+from p2p_gossipprotocol_tpu.config import (
+    ConfigError, NetworkConfig, NodeInfo, _stoi, is_valid_ip, is_valid_port,
+)
+
+
+def write(tmp_path, text):
+    p = tmp_path / "network.txt"
+    p.write_text(text)
+    return str(p)
+
+
+def test_reference_sample_config(tmp_path):
+    # 20 seeds as in reference network.txt:1-20.
+    lines = [f"192.168.1.{100 + i}:{8000 + i}" for i in range(20)]
+    cfg = NetworkConfig(write(tmp_path, "\n".join(lines)))
+    assert len(cfg.get_seed_nodes()) == 20
+    assert cfg.get_seed_nodes()[0] == NodeInfo("192.168.1.100", 8000)
+    # Quorum n//2+1 (config.cpp:76).
+    assert cfg.get_min_required_seeds() == 11
+    # Defaults (config.cpp:31-39).
+    assert cfg.get_ping_interval() == 13
+    assert cfg.get_message_interval() == 5
+    assert cfg.get_max_messages() == 10
+    assert cfg.get_max_missed_pings() == 3
+    assert cfg.get_local_ip() == "192.168.99.96"
+    assert cfg.get_local_port() == 5000
+
+
+def test_comments_and_blank_lines_skipped(tmp_path):
+    cfg = NetworkConfig(write(
+        tmp_path, "# comment\n\n   \n10.0.0.1:9000\n  # indented comment\n"))
+    assert cfg.get_seed_nodes() == [NodeInfo("10.0.0.1", 9000)]
+    assert cfg.get_min_required_seeds() == 1
+
+
+def test_key_value_params_parsed_and_plumbed(tmp_path):
+    cfg = NetworkConfig(write(tmp_path, (
+        "ping_interval = 7\nmessage_interval=2\nmax_messages = 4\n"
+        "max_missed_pings=5\n10.0.0.1:9000\n")))
+    assert cfg.get_ping_interval() == 7
+    assert cfg.get_message_interval() == 2
+    assert cfg.get_max_messages() == 4
+    assert cfg.get_max_missed_pings() == 5
+
+
+def test_unknown_keys_silently_ignored(tmp_path):
+    # config.cpp:93-96 has no else-clause for unknown keys.
+    cfg = NetworkConfig(write(tmp_path, "frobnicate=yes\n10.0.0.1:9000\n"))
+    assert len(cfg.get_seed_nodes()) == 1
+
+
+def test_empty_key_or_value_rejected_with_line_number(tmp_path):
+    with pytest.raises(ConfigError, match="Error at line 1"):
+        NetworkConfig(write(tmp_path, "=5\n10.0.0.1:9000\n"))
+    with pytest.raises(ConfigError, match="Invalid configuration format"):
+        NetworkConfig(write(tmp_path, "ping_interval=\n10.0.0.1:9000\n"))
+
+
+def test_invalid_ip_rejected(tmp_path):
+    with pytest.raises(ConfigError, match="Invalid IP address"):
+        NetworkConfig(write(tmp_path, "999.0.0.1:9000\n"))
+    with pytest.raises(ConfigError, match="Invalid IP address"):
+        NetworkConfig(write(tmp_path, "not-an-ip:9000\n"))
+
+
+def test_invalid_port_rejected(tmp_path):
+    with pytest.raises(ConfigError, match="Invalid port number"):
+        NetworkConfig(write(tmp_path, "10.0.0.1:0\n"))
+    with pytest.raises(ConfigError, match="Invalid port number"):
+        NetworkConfig(write(tmp_path, "10.0.0.1:70000\n"))
+    with pytest.raises(ConfigError, match="Invalid port format"):
+        NetworkConfig(write(tmp_path, "10.0.0.1:abc\n"))
+
+
+def test_missing_colon_rejected(tmp_path):
+    with pytest.raises(ConfigError, match="Invalid seed node format"):
+        NetworkConfig(write(tmp_path, "10.0.0.1\n"))
+
+
+def test_no_seeds_rejected(tmp_path):
+    with pytest.raises(ConfigError, match="No valid seed nodes"):
+        NetworkConfig(write(tmp_path, "# only comments\nping_interval=5\n"))
+
+
+def test_missing_file_rejected(tmp_path):
+    with pytest.raises(ConfigError, match="Unable to open config file"):
+        NetworkConfig(str(tmp_path / "nope.txt"))
+
+
+def test_nonpositive_params_rejected(tmp_path):
+    # config.cpp:122-126
+    for k in ("ping_interval", "message_interval", "max_messages",
+              "max_missed_pings"):
+        with pytest.raises(ConfigError, match="must be positive"):
+            NetworkConfig(write(tmp_path, f"{k}=0\n10.0.0.1:9000\n"))
+        with pytest.raises(ConfigError, match="must be positive"):
+            NetworkConfig(write(tmp_path, f"{k}=-3\n10.0.0.1:9000\n"))
+
+
+def test_duplicate_seeds_rejected(tmp_path):
+    # config.cpp:134-142
+    with pytest.raises(ConfigError, match="Duplicate seed nodes"):
+        NetworkConfig(write(tmp_path, "10.0.0.1:9000\n10.0.0.1:9000\n"))
+    # Same ip different port is fine.
+    cfg = NetworkConfig(write(tmp_path, "10.0.0.1:9000\n10.0.0.1:9001\n"))
+    assert cfg.get_min_required_seeds() == 2
+
+
+def test_local_address_keys_new(tmp_path):
+    # Fixes the reference's hard-coded local address (config.cpp:38-39).
+    cfg = NetworkConfig(write(
+        tmp_path, "local_ip=127.0.0.1\nlocal_port=6001\n10.0.0.1:9000\n"))
+    assert cfg.get_local_ip() == "127.0.0.1"
+    assert cfg.get_local_port() == 6001
+
+
+def test_sim_keys(tmp_path):
+    cfg = NetworkConfig(write(tmp_path, (
+        "backend=jax\ngraph=er\nmode=pushpull\nn_peers=10000\n"
+        "n_messages=16\nchurn_rate=0.05\nbyzantine_fraction=0.1\n"
+        "er_p=0.001\nprng_seed=42\n10.0.0.1:9000\n")))
+    assert cfg.backend == "jax"
+    assert cfg.graph == "er"
+    assert cfg.mode == "pushpull"
+    assert cfg.n_peers == 10000
+    assert cfg.churn_rate == 0.05
+    assert cfg.prng_seed == 42
+
+
+def test_bad_sim_values_rejected(tmp_path):
+    with pytest.raises(ConfigError, match="Unknown backend"):
+        NetworkConfig(write(tmp_path, "backend=cuda\n10.0.0.1:9000\n"))
+    with pytest.raises(ConfigError, match="Unknown graph model"):
+        NetworkConfig(write(tmp_path, "graph=torus\n10.0.0.1:9000\n"))
+    with pytest.raises(ConfigError, match="Unknown gossip mode"):
+        NetworkConfig(write(tmp_path, "mode=yell\n10.0.0.1:9000\n"))
+    with pytest.raises(ConfigError, match="churn_rate"):
+        NetworkConfig(write(tmp_path, "churn_rate=1.5\n10.0.0.1:9000\n"))
+
+
+def test_get_random_seeds(tmp_path):
+    lines = "\n".join(f"10.0.0.{i}:9000" for i in range(1, 11))
+    cfg = NetworkConfig(write(tmp_path, lines))
+    sel = cfg.get_random_seeds(5)
+    assert len(sel) == 5
+    assert len(set(sel)) == 5
+    assert all(s in cfg.get_seed_nodes() for s in sel)
+    with pytest.raises(ConfigError, match="more seeds than available"):
+        cfg.get_random_seeds(11)
+
+
+def test_to_string_shape(tmp_path):
+    cfg = NetworkConfig(write(tmp_path, "10.0.0.1:9000\n"))
+    s = cfg.to_string()
+    assert "Network Configuration:" in s
+    assert "Seed Nodes (1):" in s
+    assert "Minimum Required Seeds: 1" in s
+    assert "Ping Interval: 13 seconds" in s
+
+
+def test_stoi_semantics():
+    # std::stoi parses leading digits, ignores trailing junk.
+    assert _stoi("42") == 42
+    assert _stoi(" 42abc") == 42
+    assert _stoi("-7") == -7
+    with pytest.raises(ValueError):
+        _stoi("abc")
+
+
+def test_ip_port_validators():
+    assert is_valid_ip("192.168.1.1")
+    assert not is_valid_ip("192.168.1")
+    assert not is_valid_ip("192.168.1.256")
+    assert is_valid_port(1) and is_valid_port(65535)
+    assert not is_valid_port(0) and not is_valid_port(65536)
+
+
+def test_non_numeric_int_values_raise_config_error(tmp_path):
+    # Review finding: stoi failures must surface as line-numbered ConfigError.
+    with pytest.raises(ConfigError, match="Error at line 1: Invalid value"):
+        NetworkConfig(write(tmp_path, "ping_interval=fast\n10.0.0.1:9000\n"))
+
+
+def test_local_address_validated(tmp_path):
+    with pytest.raises(ConfigError, match="Invalid local_ip"):
+        NetworkConfig(write(tmp_path, "local_ip=banana\n10.0.0.1:9000\n"))
+    with pytest.raises(ConfigError, match="Invalid local_port"):
+        NetworkConfig(write(tmp_path, "local_port=70000\n10.0.0.1:9000\n"))
+
+
+def test_negative_sim_ints_rejected(tmp_path):
+    with pytest.raises(ConfigError, match="must be non-negative"):
+        NetworkConfig(write(tmp_path, "n_peers=-5\n10.0.0.1:9000\n"))

@@ -1,0 +1,52 @@
+"""Data-model + message-identity tests (reference info.hpp, peer.cpp:135-159)."""
+
+from p2p_gossipprotocol_tpu.info import (
+    Message, PeerInfo, calculate_message_hash,
+)
+
+
+def test_peerinfo_equality_ignores_last_seen():
+    # info.hpp:11-13
+    a = PeerInfo("10.0.0.1", 9000, last_seen=1.0)
+    b = PeerInfo("10.0.0.1", 9000, last_seen=999.0)
+    assert a == b
+    assert hash(a) == hash(b)
+    assert len({a, b}) == 1
+
+
+def test_peerinfo_json_roundtrip():
+    p = PeerInfo("10.0.0.1", 9000, last_seen=1700000000.0)
+    j = p.to_json()
+    assert j == {"ip": "10.0.0.1", "port": 9000, "lastSeen": 1700000000}
+    assert PeerInfo.from_json(j) == p
+
+
+def test_message_wire_shape():
+    # Field names from peer.cpp:299-305.
+    m = Message("hi", "123456789", "10.0.0.1", 9000, 3, "abcd")
+    w = m.to_wire()
+    assert w["type"] == "gossip"
+    assert set(w) == {"type", "content", "timestamp", "source_ip",
+                      "source_port", "msg_number", "hash"}
+    assert Message.from_wire(w) == m
+
+
+def test_hash_covers_content_timestamp_ip_only():
+    # peer.cpp:145-147: port and msg_number are NOT part of identity.
+    base = Message("hello", "111", "10.0.0.1", 9000, 0)
+    same = Message("hello", "111", "10.0.0.1", 9999, 7)
+    diff = Message("hello!", "111", "10.0.0.1", 9000, 0)
+    assert calculate_message_hash(base) == calculate_message_hash(same)
+    assert calculate_message_hash(base) != calculate_message_hash(diff)
+    assert calculate_message_hash(
+        Message("hello", "222", "10.0.0.1", 9000, 0)
+    ) != calculate_message_hash(base)
+    assert calculate_message_hash(
+        Message("hello", "111", "10.0.0.2", 9000, 0)
+    ) != calculate_message_hash(base)
+
+
+def test_hash_is_sha256_hex():
+    h = calculate_message_hash(Message("x", "1", "10.0.0.1", 1, 0))
+    assert len(h) == 64
+    int(h, 16)  # valid hex
