@@ -1,0 +1,147 @@
+"""CLI entry point: ``peer_network <config_file>``.
+
+Preserves the reference's invocation exactly (main.cpp:29-34: one
+positional config-file argument, usage message on error, SIGINT/SIGTERM
+graceful shutdown, config printed at startup) and adds what it lacks:
+
+* ``--backend {jax,socket}`` — TPU simulation vs n-terminal socket mode;
+* ``--role {peer,seed}``     — a real entry point for the seed role the
+  reference defined but never wired up (SURVEY §3.5);
+* ``--n-peers/--rounds/--mode/...`` — simulation overrides;
+* a machine-readable result line (JSON) after a jax-backend run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import time
+
+from p2p_gossipprotocol_tpu.config import ConfigError, NetworkConfig
+
+
+def print_usage(prog: str) -> None:
+    # Text shape mirrors printUsage (main.cpp:24-27).
+    print(f"Usage: {prog} <config_file>", file=sys.stderr)
+    print("  config_file: Path to network configuration file",
+          file=sys.stderr)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="peer_network", add_help=True,
+        description="TPU-native gossip network "
+                    "(capabilities of PareenShah27/P2P-GossipProtocol)")
+    p.add_argument("config_file", help="network configuration file")
+    p.add_argument("--backend", choices=["jax", "socket"], default=None,
+                   help="override config backend")
+    p.add_argument("--role", choices=["peer", "seed"], default="peer",
+                   help="socket mode: run a peer or a seed server")
+    p.add_argument("--n-peers", type=int, default=None,
+                   help="jax mode: simulated peer count")
+    p.add_argument("--rounds", type=int, default=None,
+                   help="jax mode: rounds to simulate")
+    p.add_argument("--mode", choices=["push", "pull", "pushpull"],
+                   default=None, help="gossip mode override")
+    p.add_argument("--target-coverage", type=float, default=0.99)
+    p.add_argument("--local-ip", default=None)
+    p.add_argument("--local-port", type=int, default=None)
+    p.add_argument("--quiet", action="store_true")
+    return p
+
+
+def _run_jax(cfg: NetworkConfig, args) -> int:
+    from p2p_gossipprotocol_tpu.sim import Simulator
+
+    sim = Simulator.from_config(cfg, n_peers=args.n_peers)
+    rounds = args.rounds or cfg.rounds or 64
+    if not args.quiet:
+        print(f"[jax] simulating {sim.topo.n_peers} peers, "
+              f"{sim.n_msgs} messages, mode={sim.mode}, "
+              f"{int(sim.topo.n_edges())} edges")
+    res = sim.run(rounds)
+    r99 = res.rounds_to(args.target_coverage)
+    if not args.quiet:
+        for i in range(len(res.coverage)):
+            print(f"round {i + 1:4d}  coverage={res.coverage[i]:.4f}  "
+                  f"frontier={res.frontier_size[i]:8d}  "
+                  f"live={res.live_peers[i]:8d}  "
+                  f"evictions={res.evictions[i]:6d}")
+            if res.coverage[i] >= 0.999999 and res.frontier_size[i] == 0:
+                break
+    print(json.dumps({
+        "n_peers": sim.topo.n_peers,
+        "n_msgs": sim.n_msgs,
+        "mode": sim.mode,
+        "rounds_run": rounds,
+        "final_coverage": float(res.coverage[-1]),
+        f"rounds_to_{args.target_coverage:g}": r99,
+        "total_deliveries": res.total_deliveries,
+        "wall_s": round(res.wall_s, 4),
+    }))
+    return 0
+
+
+def _run_socket(cfg: NetworkConfig, args) -> int:
+    stop = {"flag": False}
+
+    def handler(signum, frame):  # main.cpp:14-22
+        print("\nReceived signal to terminate. Shutting down...",
+              file=sys.stderr)
+        stop["flag"] = True
+
+    signal.signal(signal.SIGINT, handler)
+    signal.signal(signal.SIGTERM, handler)
+
+    if args.role == "seed":
+        from p2p_gossipprotocol_tpu.seed import SeedNode
+
+        node = SeedNode(cfg.get_local_ip(), cfg.get_local_port())
+        node.start()
+    else:
+        from p2p_gossipprotocol_tpu.wrapper import Peer
+
+        node = Peer(args.config_file, config=cfg)
+        node.start()
+
+    try:
+        while not stop["flag"] and node.is_running():
+            time.sleep(0.1)  # main.cpp:59-61
+    finally:
+        node.stop()
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print_usage("peer_network")
+        return 1
+    args = build_parser().parse_args(argv)
+    try:
+        cfg = NetworkConfig(args.config_file)
+    except ConfigError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+
+    if args.backend:
+        cfg.backend = args.backend
+    if args.local_ip:
+        cfg.local_ip = args.local_ip
+    if args.local_port:
+        cfg.local_port = args.local_port
+    if args.mode:
+        cfg.mode = args.mode
+
+    if not args.quiet:
+        print(cfg.to_string())  # main.cpp:48
+
+    if cfg.backend == "jax" and args.role == "peer":
+        return _run_jax(cfg, args)
+    return _run_socket(cfg, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,7 +76,7 @@ def _stoi(value: str) -> int:
     if i < len(s) and s[i] in "+-":
         i += 1
     j = i
-    while j < len(s) and s[j].isdigit():
+    while j < len(s) and s[j] in "0123456789":
         j += 1
     if j == i:
         raise ValueError(f"stoi: no conversion: {value!r}")

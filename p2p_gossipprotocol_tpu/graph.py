@@ -1,0 +1,216 @@
+"""Overlay topology construction — the TPU-native replacement for the
+reference's socket-connection graph.
+
+In the reference the overlay is implicit: each peer TCP-connects to a
+power-law-sized random subset of the seed-provided peer list
+(selectAndConnectPeers, peer.cpp:214-253: ``numPeers = min(n, n *
+u^(1/alpha))`` with alpha = 2.5, uniformly shuffled targets, self skipped),
+and "registration" with a seed (seed.cpp:109-128) adds the peer to the
+candidate list.  Here that whole machinery degenerates to *graph
+construction*: the overlay is a fixed-capacity directed edge set held in
+HBM.
+
+TPU-first design constraints honored here:
+
+* **Static shapes** — edge arrays are padded to a fixed capacity with a
+  validity mask, so churn/eviction/rewiring can mutate ``dst``/``edge_mask``
+  inside ``lax.scan`` without ever re-materializing a sparse matrix
+  (SURVEY.md §7 hard part (b)).
+* **CSR row offsets** — edges are sorted by ``src`` with ``row_ptr`` so
+  per-peer neighbor sampling (pull gossip, rewiring) is O(1) gathers.
+* Construction is host-side NumPy (one-time setup, not the hot path);
+  everything the per-round kernels touch is a JAX pytree.
+
+Graph models (BASELINE.json configs):
+  * ``reference`` — the reference's power-law fanout law, vectorized.
+  * ``er``        — Erdős–Rényi G(n, p) / G(n, avg_degree).
+  * ``ba``        — Barabási–Albert preferential attachment.
+  * ``powerlaw``  — alias of ``reference`` with a degree cap for huge n.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+_PAD_MULTIPLE = 1024
+
+
+@struct.dataclass
+class Topology:
+    """Fixed-capacity directed overlay graph (pytree).
+
+    Edges are sorted by ``src``; ``row_ptr[i]:row_ptr[i+1]`` is peer ``i``'s
+    out-edge slice.  Padded tail slots have ``edge_mask == False`` and
+    ``src == dst == 0`` and are not inside any row.  ``dst`` and
+    ``edge_mask`` are mutable state (churn rewires them); ``src`` and
+    ``row_ptr`` are fixed for the lifetime of the simulation.
+    """
+
+    src: jax.Array        # int32[E_cap]
+    dst: jax.Array        # int32[E_cap]
+    edge_mask: jax.Array  # bool[E_cap]
+    row_ptr: jax.Array    # int32[n_peers + 1]
+    n_peers: int = struct.field(pytree_node=False)
+
+    @property
+    def edge_capacity(self) -> int:
+        return self.src.shape[0]
+
+    def out_degrees(self) -> jax.Array:
+        """Structural out-degree per peer (row widths, ignoring the mask)."""
+        return self.row_ptr[1:] - self.row_ptr[:-1]
+
+    def live_out_degrees(self) -> jax.Array:
+        """Mask-aware out-degree per peer."""
+        deg = jnp.zeros(self.n_peers, jnp.int32)
+        return deg.at[self.src].add(self.edge_mask.astype(jnp.int32))
+
+    def n_edges(self) -> jax.Array:
+        return jnp.sum(self.edge_mask.astype(jnp.int32))
+
+    def to_bcoo(self):
+        """Adjacency as ``jax.experimental.sparse.BCOO`` (float32, n × n)
+        for interop with sparse linear algebra; masked-out edges contribute
+        0.  float32 because that is what the MXU consumes for SpMV."""
+        from jax.experimental import sparse
+
+        idx = jnp.stack([self.src, self.dst], axis=1)
+        return sparse.BCOO((self.edge_mask.astype(jnp.float32), idx),
+                           shape=(self.n_peers, self.n_peers))
+
+
+def _pad_and_build(n: int, src: np.ndarray, dst: np.ndarray,
+                   pad_multiple: int = _PAD_MULTIPLE) -> Topology:
+    """Sort edges by src, build CSR offsets, pad to capacity."""
+    src = np.asarray(src, np.int64)
+    dst = np.asarray(dst, np.int64)
+    keep = (src != dst) & (src >= 0) & (dst >= 0) & (src < n) & (dst < n)
+    src, dst = src[keep], dst[keep]
+    order = np.argsort(src, kind="stable")
+    src, dst = src[order], dst[order]
+    e = src.shape[0]
+    cap = max(pad_multiple, -(-max(e, 1) // pad_multiple) * pad_multiple)
+    row_ptr = np.zeros(n + 1, np.int64)
+    np.add.at(row_ptr, src + 1, 1)
+    row_ptr = np.cumsum(row_ptr)
+    pad = cap - e
+    return Topology(
+        src=jnp.asarray(np.concatenate([src, np.zeros(pad, np.int64)]),
+                        jnp.int32),
+        dst=jnp.asarray(np.concatenate([dst, np.zeros(pad, np.int64)]),
+                        jnp.int32),
+        edge_mask=jnp.asarray(
+            np.concatenate([np.ones(e, bool), np.zeros(pad, bool)])),
+        row_ptr=jnp.asarray(row_ptr, jnp.int32),
+        n_peers=n,
+    )
+
+
+def reference_powerlaw(seed: int, n: int, alpha: float = 2.5,
+                       max_degree: int | None = None,
+                       undirected: bool = True) -> Topology:
+    """The reference's overlay law, vectorized over all peers at once.
+
+    Per peer: degree ``min(n, floor(n * u^(1/alpha)))`` with u ~ U(0,1)
+    (peer.cpp:219-222), targets uniform over other peers (the shuffle at
+    peer.cpp:224-225), self skipped (peer.cpp:230).  ``max_degree`` caps
+    per-peer fanout so edge capacity stays bounded at 1M+ peers (the
+    reference never runs at that scale; the cap only binds in the far tail
+    of the power law).  ``undirected=True`` adds reverse edges — TCP
+    connections are bidirectional links; set False for the reference's
+    strictly-directed message flow (broadcasts traverse outbound
+    connections only, peer.cpp:310-312).
+    """
+    rng = np.random.default_rng(seed)
+    u = rng.uniform(0.0, 1.0, size=n)
+    deg = np.minimum(n, (n * u ** (1.0 / alpha)).astype(np.int64))
+    deg = np.minimum(deg, n - 1)
+    if max_degree is not None:
+        deg = np.minimum(deg, max_degree)
+    total = int(deg.sum())
+    src = np.repeat(np.arange(n, dtype=np.int64), deg)
+    # Uniform target != self: offset trick (duplicate targets possible with
+    # probability ~deg²/2n — the reference's shuffle avoids them, but a
+    # duplicate TCP link is behaviorally identical for gossip).
+    offs = rng.integers(1, n, size=total, dtype=np.int64) if n > 1 else \
+        np.zeros(total, np.int64)
+    dst = (src + offs) % n
+    if undirected:
+        src, dst = np.concatenate([src, dst]), np.concatenate([dst, src])
+    return _pad_and_build(n, src, dst)
+
+
+def erdos_renyi(seed: int, n: int, avg_degree: float | None = None,
+                p: float | None = None) -> Topology:
+    """G(n, p) via edge-count sampling: m ~ Binomial(n(n-1)/2, p) undirected
+    pairs drawn uniformly (collisions negligible for sparse graphs)."""
+    if p is None:
+        if avg_degree is None:
+            raise ValueError("erdos_renyi needs avg_degree or p")
+        p = min(1.0, avg_degree / max(n - 1, 1))
+    rng = np.random.default_rng(seed)
+    n_pairs = n * (n - 1) // 2
+    m = int(rng.binomial(n_pairs, p)) if n_pairs else 0
+    a = rng.integers(0, n, size=m, dtype=np.int64)
+    offs = rng.integers(1, n, size=m, dtype=np.int64) if n > 1 else \
+        np.zeros(m, np.int64)
+    b = (a + offs) % n
+    return _pad_and_build(n, np.concatenate([a, b]), np.concatenate([b, a]))
+
+
+def barabasi_albert(seed: int, n: int, m: int = 4) -> Topology:
+    """Preferential attachment: each new node attaches to ``m`` targets
+    sampled ∝ degree, via the standard repeated-endpoints list (so the
+    whole build is O(E))."""
+    if n < 2:
+        raise ValueError("barabasi_albert needs n >= 2")
+    m = max(1, min(m, n - 1))
+    rng = np.random.default_rng(seed)
+    # Seed clique of m+1 nodes.
+    m0 = m + 1
+    seed_src, seed_dst = np.triu_indices(m0, k=1)
+    endpoints = list(np.concatenate([seed_src, seed_dst]))
+    srcs = [np.asarray(seed_src, np.int64)]
+    dsts = [np.asarray(seed_dst, np.int64)]
+    # Pre-draw randomness; sample targets from the endpoints list (∝ degree).
+    for v in range(m0, n):
+        pool = np.asarray(endpoints, dtype=np.int64)
+        targets = np.unique(pool[rng.integers(0, len(pool), size=2 * m)])[:m]
+        while targets.size < m:  # rare: top up with uniform others
+            extra = rng.integers(0, v, size=m)
+            targets = np.unique(np.concatenate([targets, extra]))[:m]
+        srcs.append(np.full(targets.size, v, np.int64))
+        dsts.append(targets)
+        endpoints.extend([v] * targets.size)
+        endpoints.extend(targets.tolist())
+    src = np.concatenate(srcs)
+    dst = np.concatenate(dsts)
+    return _pad_and_build(n, np.concatenate([src, dst]),
+                          np.concatenate([dst, src]))
+
+
+def from_config(cfg, n_peers: int | None = None) -> Topology:
+    """Build the overlay a :class:`NetworkConfig` describes.
+
+    ``graph=reference`` with no explicit ``n_peers`` simulates one peer per
+    configured seed node — the README's "run in n terminals" scenario
+    (reference README.md:4) collapsed into one process.
+    """
+    n = n_peers or cfg.n_peers or len(cfg.seed_nodes)
+    g = cfg.graph
+    if g in ("reference", "powerlaw"):
+        cap = None if g == "reference" and n <= 100_000 else max(
+            64, cfg.avg_degree * 8)
+        return reference_powerlaw(cfg.prng_seed, n, alpha=cfg.powerlaw_alpha,
+                                  max_degree=cap)
+    if g == "er":
+        return erdos_renyi(cfg.prng_seed, n,
+                           avg_degree=cfg.avg_degree,
+                           p=cfg.er_p or None)
+    if g == "ba":
+        return barabasi_albert(cfg.prng_seed, n, m=cfg.ba_m)
+    raise ValueError(f"Unknown graph model: {g}")

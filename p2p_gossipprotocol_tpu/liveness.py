@@ -1,0 +1,92 @@
+"""Liveness, churn, eviction, and rewiring — the vectorization of the
+reference's failure-detection subsystem (SURVEY.md §2-C10, §3.4).
+
+Reference behavior being modelled:
+  * ``pingLoop`` ICMP-pings each connected peer every ping_interval and
+    marks it dead after ``max_missed_pings`` consecutive failures
+    (peer.cpp:320-355; hard-coded 13 s / 3 strikes — we honor the config
+    values the reference parses but ignores, SURVEY §2-C2).
+  * ``handleDeadPeer`` drops the link and re-bootstraps through the seeds,
+    acquiring replacement links (peer.cpp:381-405).
+
+TPU-native form:
+  * churn is a PRNG-keyed kill/revive mask over the alive vector —
+    deterministic fault injection replacing "Ctrl-C a terminal"
+    (README.md:6);
+  * a "ping" is an observation of the neighbor's alive bit: per-EDGE strike
+    counters accumulate consecutive rounds the dst looked dead (one round ≈
+    one ping interval);
+  * eviction at ``max_strikes`` rewires the edge's dst to a uniformly
+    random live peer — the re-bootstrap analogue — in place, keeping
+    shapes static (fixed-capacity edge arrays, SURVEY §7 hard part (b)).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from p2p_gossipprotocol_tpu.graph import Topology
+
+
+@struct.dataclass
+class ChurnConfig:
+    """Per-round death/revival probabilities.  ``rate=0.05, revive=0.0``
+    reproduces the BASELINE "5% churn" config as a one-shot kill when
+    ``kill_round >= 0`` (that fraction dies at that round), or as a
+    continuous hazard when ``kill_round < 0``."""
+
+    rate: float = struct.field(pytree_node=False, default=0.0)
+    revive: float = struct.field(pytree_node=False, default=0.0)
+    kill_round: int = struct.field(pytree_node=False, default=-1)
+
+
+def churn_step(key: jax.Array, alive: jax.Array, round_idx: jax.Array,
+               cfg: ChurnConfig) -> jax.Array:
+    """Advance the alive mask one round under the churn schedule."""
+    if cfg.rate <= 0.0 and cfg.revive <= 0.0:
+        return alive
+    k_die, k_rev = jax.random.split(key)
+    n = alive.shape[0]
+    if cfg.kill_round >= 0:
+        dies = ((round_idx == cfg.kill_round)
+                & (jax.random.uniform(k_die, (n,)) < cfg.rate))
+    else:
+        dies = jax.random.uniform(k_die, (n,)) < cfg.rate
+    revives = jax.random.uniform(k_rev, (n,)) < cfg.revive
+    return (alive & ~dies) | (~alive & revives)
+
+
+def strike_and_rewire(key: jax.Array, topo: Topology, strikes: jax.Array,
+                      alive: jax.Array, max_strikes: int = 3,
+                      rewire: bool = True
+                      ) -> tuple[Topology, jax.Array, jax.Array]:
+    """One liveness observation round over every edge.
+
+    Edges whose dst is dead gain a strike; a live observation clears the
+    counter (the reference resets ``failedPings`` on ping success,
+    peer.cpp:341-344).  At ``max_strikes`` the edge is evicted; with
+    ``rewire=True`` its dst is replaced by a random peer (accepted only if
+    that peer is live — otherwise retry in later rounds), mirroring the
+    re-bootstrap at peer.cpp:400-404.  Returns
+    ``(topo', strikes', evictions_this_round)``.
+    """
+    dst_dead = topo.edge_mask & ~alive[topo.dst]
+    strikes = jnp.where(dst_dead, strikes + 1, 0)
+    evict = strikes >= max_strikes
+    n_evict = jnp.sum(evict, dtype=jnp.int32)
+    if not rewire:
+        new_mask = topo.edge_mask & ~evict
+        return (topo.replace(edge_mask=new_mask),
+                jnp.where(evict, 0, strikes), n_evict)
+    # Replacement candidate: uniform peer != src (same offset trick the
+    # graph builder uses); accept only live candidates.
+    e = topo.edge_capacity
+    n = topo.n_peers
+    offs = jax.random.randint(key, (e,), 1, jnp.maximum(n, 2))
+    cand = (topo.src + offs) % n
+    take = evict & alive[cand]
+    new_dst = jnp.where(take, cand, topo.dst)
+    strikes = jnp.where(take, 0, strikes)
+    return topo.replace(dst=new_dst), strikes, n_evict

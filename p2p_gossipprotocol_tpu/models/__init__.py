@@ -1,0 +1,14 @@
+"""Dissemination models: flood/fanout push, pull, push-pull, SIR,
+Byzantine injection."""
+
+from p2p_gossipprotocol_tpu.models.gossip import (
+    push_round,
+    pull_round,
+    pushpull_round,
+    make_round_fn,
+)
+from p2p_gossipprotocol_tpu.models.sir import sir_round
+from p2p_gossipprotocol_tpu.models.byzantine import inject_byzantine
+
+__all__ = ["push_round", "pull_round", "pushpull_round", "make_round_fn",
+           "sir_round", "inject_byzantine"]

@@ -1,0 +1,48 @@
+"""Byzantine rumor injection + recovery (BASELINE.json config 5).
+
+Adversary model: a fixed fraction of peers is byzantine.  They
+  * never relay honest rumors (suppression — handled in models/gossip.py
+    by masking their sends), and
+  * inject junk rumors into reserved message columns, trying to crowd the
+    network's attention.
+
+"Recovery" is measured as honest-rumor coverage over honest live peers —
+the network still converges because honest flood/anti-entropy routes
+around the suppressors.  The message axis is split: columns
+``[0, n_honest)`` are honest rumors, ``[n_honest, n_msgs)`` are the
+adversary's injection budget.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from p2p_gossipprotocol_tpu.state import GossipState
+
+
+def inject_byzantine(state: GossipState, n_honest: int) -> GossipState:
+    """Byzantine peers seed every junk column they haven't yet — call once
+    per round (or once at start) before the gossip round.
+
+    Junk enters each byzantine peer's frontier, so neighbors will hear it —
+    honest peers DO relay junk (they cannot tell it apart), which is what
+    makes injection a real attack on bandwidth rather than a no-op.
+    """
+    n_msgs = state.n_msgs
+    if n_honest >= n_msgs:
+        return state
+    junk_cols = jnp.arange(n_msgs) >= n_honest
+    inject = state.byzantine[:, None] & junk_cols[None, :] & ~state.seen
+    return state.replace(seen=state.seen | inject,
+                         frontier=state.frontier | inject)
+
+
+def honest_coverage(state: GossipState, n_honest: int) -> jax.Array:
+    """Mean over honest rumor columns of the fraction of live honest peers
+    that have seen the rumor."""
+    honest_peer = state.alive & ~state.byzantine
+    denom = jnp.maximum(jnp.sum(honest_peer, dtype=jnp.int32), 1)
+    per_msg = (jnp.sum(state.seen & honest_peer[:, None], axis=0,
+                       dtype=jnp.int32) / denom)
+    return jnp.mean(per_msg[:n_honest])

@@ -1,0 +1,102 @@
+"""Gossip round kernels — the vectorization of the reference's
+dissemination loop (SURVEY.md §3.2/§3.3).
+
+One call = one synchronous-parallel round in which EVERY peer performs what
+the reference's ``handleClient``/``broadcastMessage`` pair does for one
+socket (peer.cpp:255-318): receive, dedup against the seen-set, and relay
+novel messages to neighbors.  The reference's recursive-mutex deadlock on
+the receive-and-relay path (peer.cpp:280-314, SURVEY §2-C11) cannot exist
+here — there is no shared mutable state at all.
+
+Semantics preserved from the reference:
+  * flood-once: a peer relays a message only the round after first receipt
+    (``frontier``), matching the dedup-then-broadcast at peer.cpp:281-284;
+  * dead peers neither send nor receive (the link is gone);
+  * push is the reference's only mode (peer.cpp:297-318); pull and
+    push-pull anti-entropy are the standard completions the BASELINE
+    configs call for.
+
+Byzantine peers receive but never relay, modelling rumor-suppressing
+adversaries; injection of junk rumors lives in models/byzantine.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from p2p_gossipprotocol_tpu.graph import Topology
+from p2p_gossipprotocol_tpu.ops.propagate import (
+    edge_or_scatter,
+    sample_fanout_gate,
+    sample_out_neighbor,
+)
+from p2p_gossipprotocol_tpu.state import GossipState
+
+
+def _advance(state: GossipState, recv: jax.Array, key: jax.Array
+             ) -> tuple[GossipState, jax.Array]:
+    """Fold received bits into the state; returns (state', deliveries)."""
+    recv = recv & state.alive[:, None]
+    new = recv & ~state.seen
+    deliveries = jnp.sum(new, dtype=jnp.int32)
+    state = state.replace(seen=state.seen | new, frontier=new, key=key,
+                          round=state.round + 1)
+    return state, deliveries
+
+
+def push_round(state: GossipState, topo: Topology, fanout: int = 0
+               ) -> tuple[GossipState, jax.Array]:
+    """Flood push (fanout=0, the reference's broadcast) or bounded-fanout
+    rumor mongering (fanout>0)."""
+    key, k_fan = jax.random.split(state.key)
+    send = state.frontier & state.alive[:, None] & ~state.byzantine[:, None]
+    gate = sample_fanout_gate(k_fan, topo, fanout) if fanout > 0 else None
+    recv = edge_or_scatter(send, topo, gate)
+    return _advance(state, recv, key)
+
+
+def pull_round(state: GossipState, topo: Topology
+               ) -> tuple[GossipState, jax.Array]:
+    """Anti-entropy pull: every live peer contacts one random neighbor and
+    copies its seen-set (the neighbor's full ``messageList``)."""
+    key, k_nbr = jax.random.split(state.key)
+    nbr, valid = sample_out_neighbor(k_nbr, topo)
+    ok = (valid & state.alive & state.alive[nbr]
+          & ~state.byzantine[nbr])          # byz peers refuse to serve pulls
+    recv = state.seen[nbr] & ok[:, None]
+    return _advance(state, recv, key)
+
+
+def pushpull_round(state: GossipState, topo: Topology, fanout: int = 0
+                   ) -> tuple[GossipState, jax.Array]:
+    """Push-pull: one contact per peer serves both directions (the classic
+    anti-entropy exchange), plus the flood/fanout push of novel rumors."""
+    key, k_fan, k_nbr = jax.random.split(state.key, 3)
+    send = state.frontier & state.alive[:, None] & ~state.byzantine[:, None]
+    gate = sample_fanout_gate(k_fan, topo, fanout) if fanout > 0 else None
+    recv = edge_or_scatter(send, topo, gate)
+
+    nbr, valid = sample_out_neighbor(k_nbr, topo)
+    contact = valid & state.alive & state.alive[nbr]
+    # pull: i copies nbr(i)'s seen-set (unless nbr is byzantine)
+    recv = recv | (state.seen[nbr] & (contact & ~state.byzantine[nbr])[:, None])
+    # push half of the exchange: nbr(i) receives i's seen-set (unless i is
+    # byzantine) — scatter-OR over the sampled contacts.
+    give = state.seen & (contact & ~state.byzantine)[:, None]
+    recv = recv.at[nbr].max(give, mode="drop")
+    return _advance(state, recv, key)
+
+
+def make_round_fn(mode: str, fanout: int = 0):
+    """Round function for a config ``mode`` (push | pull | pushpull),
+    signature ``(state, topo) -> (state', deliveries)``."""
+    if mode == "push":
+        return partial(push_round, fanout=fanout)
+    if mode == "pull":
+        return pull_round
+    if mode == "pushpull":
+        return partial(pushpull_round, fanout=fanout)
+    raise ValueError(f"Unknown gossip mode: {mode}")

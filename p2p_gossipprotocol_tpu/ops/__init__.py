@@ -1,0 +1,9 @@
+"""Propagation primitives: edge OR-scatter, neighbor sampling."""
+
+from p2p_gossipprotocol_tpu.ops.propagate import (
+    edge_or_scatter,
+    edge_count_scatter,
+    sample_out_neighbor,
+)
+
+__all__ = ["edge_or_scatter", "edge_count_scatter", "sample_out_neighbor"]

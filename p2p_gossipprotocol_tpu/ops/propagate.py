@@ -1,0 +1,88 @@
+"""Core propagation primitives.
+
+These vectorize the reference's hot path (SURVEY.md §3.2): ``handleClient``
+receiving one message on one socket and relaying it over each connected
+socket (peer.cpp:255-318) becomes ONE gather + segment-OR over the whole
+edge set for all peers and all messages simultaneously — the shape XLA
+tiles well on TPU (a gather, an elementwise AND, a scatter-max; no
+data-dependent control flow).
+
+Booleans use scatter-**max** as OR (max over {0,1} == OR), the idiom XLA
+lowers to a single fused scatter.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from p2p_gossipprotocol_tpu.graph import Topology
+
+
+def edge_or_scatter(active: jax.Array, topo: Topology,
+                    edge_gate: jax.Array | None = None) -> jax.Array:
+    """For each peer, OR together ``active[src]`` over its in-edges.
+
+    ``active``: bool[n_peers, n_msgs] — which messages each peer is
+    transmitting this round.  Returns bool[n_peers, n_msgs]: which messages
+    each peer hears.  ``edge_gate``: optional extra bool[E_cap] mask ANDed
+    with the structural edge mask (used for per-round sampled fanout and
+    liveness gating).
+
+    This is the masked-SpMV dissemination kernel from SURVEY.md §3.2's
+    closing note: ``new_seen = adjacency @ frontier`` in boolean algebra.
+    """
+    gate = topo.edge_mask if edge_gate is None else (topo.edge_mask
+                                                     & edge_gate)
+    vals = active[topo.src] & gate[:, None]
+    out = jnp.zeros_like(active)
+    return out.at[topo.dst].max(vals, mode="drop")
+
+
+def edge_count_scatter(active: jax.Array, topo: Topology,
+                       edge_gate: jax.Array | None = None) -> jax.Array:
+    """Like :func:`edge_or_scatter` but counts transmitting in-neighbors
+    (int32) instead of OR-ing — used by SIR (infection pressure) and by
+    delivery accounting (simulated message transmissions)."""
+    gate = topo.edge_mask if edge_gate is None else (topo.edge_mask
+                                                     & edge_gate)
+    vals = (active[topo.src] & gate[:, None]).astype(jnp.int32)
+    out = jnp.zeros(active.shape, jnp.int32)
+    return out.at[topo.dst].add(vals, mode="drop")
+
+
+def sample_out_neighbor(key: jax.Array, topo: Topology
+                        ) -> tuple[jax.Array, jax.Array]:
+    """Each peer samples one uniform out-neighbor (for pull gossip —
+    anti-entropy, the half of push-pull the reference lacks, SURVEY §2-C11).
+
+    Returns ``(neighbor: int32[n], valid: bool[n])``.  A peer with no
+    out-edges, or whose sampled edge slot is masked off (evicted), gets
+    ``valid=False`` — the round's contact simply fails, which is exactly a
+    refused TCP connect in the reference.
+    """
+    n = topo.n_peers
+    deg = topo.row_ptr[1:] - topo.row_ptr[:-1]
+    u = jax.random.uniform(key, (n,))
+    offs = (u * deg.astype(jnp.float32)).astype(jnp.int32)
+    offs = jnp.minimum(offs, jnp.maximum(deg - 1, 0))
+    idx = topo.row_ptr[:-1] + offs
+    idx = jnp.minimum(idx, topo.edge_capacity - 1)
+    neighbor = topo.dst[idx]
+    valid = (deg > 0) & topo.edge_mask[idx]
+    return neighbor, valid
+
+
+def sample_fanout_gate(key: jax.Array, topo: Topology,
+                       fanout: int) -> jax.Array:
+    """Per-round edge gate keeping ≈``fanout`` random out-edges per peer.
+
+    Bernoulli per edge with rate fanout/deg(src) — the static-shape way to
+    do rumor-mongering with bounded fanout instead of full flood
+    (the reference always floods, peer.cpp:310-312; bounded fanout is the
+    standard gossip variant the BASELINE configs exercise at scale).
+    """
+    deg = (topo.row_ptr[1:] - topo.row_ptr[:-1]).astype(jnp.float32)
+    rate = jnp.minimum(1.0, fanout / jnp.maximum(deg, 1.0))
+    u = jax.random.uniform(key, (topo.edge_capacity,))
+    return u < rate[topo.src]

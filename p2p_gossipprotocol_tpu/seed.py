@@ -1,0 +1,114 @@
+"""Seed / membership directory server — socket mode.
+
+Functional equivalent of the reference's ``SeedNode`` (seed.cpp), with the
+two structural defects SURVEY.md §2-C6 flags fixed:
+
+* the reference never wires SeedNode to any entry point (no code constructs
+  one; the only binary is ``peer_network``) — here ``peer_network
+  --role=seed`` runs one (cli.py);
+* the ``dead_node`` half of the protocol had no sender — our PeerNode
+  actually notifies seeds on eviction (peer.py), so ``handleDeadNode``
+  (seed.cpp:158-167) finally has a caller.
+
+Wire protocol (byte-compatible with seed.cpp:92-151):
+  recv {"type":"register","ip":...,"port":...}
+      → store peer, reply {"type":"peer_list","peers":[{ip,port,lastSeen}]}
+  recv {"type":"dead_node","dead_ip":...,"dead_port":...}
+      → drop peer, no reply
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from p2p_gossipprotocol_tpu.info import PeerInfo
+from p2p_gossipprotocol_tpu.transport.socket_transport import (
+    JsonStream, SocketTransport, send_json)
+from p2p_gossipprotocol_tpu.utils.logging import NodeLogger
+
+
+class SeedNode:
+    """Peer registry: accept loop + thread-per-client (seed.cpp:64-79)."""
+
+    def __init__(self, ip: str, port: int, log_dir: str = "."):
+        self.ip = ip
+        self.port = port
+        self.transport = SocketTransport(ip, port)
+        self.peer_list: dict[tuple[str, int], PeerInfo] = {}
+        self._lock = threading.Lock()
+        self.running = False
+        self._threads: list[threading.Thread] = []
+        self.log = NodeLogger("seed", port, log_dir)
+
+    # -- lifecycle (seed.hpp:9-34 API) ---------------------------------
+    def start(self) -> None:
+        self.transport.start()
+        self.running = True
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        self.log.log(f"Seed node started on {self.ip}:{self.port}")
+
+    def stop(self) -> None:
+        self.running = False
+        self.transport.stop()
+
+    def is_running(self) -> bool:
+        return self.running
+
+    # -- registry (seed.cpp:153-178) -----------------------------------
+    def add_peer(self, peer: PeerInfo) -> None:
+        with self._lock:
+            self.peer_list[(peer.ip, peer.port)] = peer
+
+    def handle_dead_node(self, ip: str, port: int) -> None:
+        with self._lock:
+            self.peer_list.pop((ip, port), None)
+        self.log.log(f"Removed dead node: {ip}:{port}")
+
+    def get_peer_list(self) -> list[PeerInfo]:
+        with self._lock:
+            return list(self.peer_list.values())
+
+    # -- serving -------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while self.running:
+            conn, _ = self.transport.accept(timeout=0.25)
+            if conn is None:
+                continue
+            self.log.log("New client connection accepted")
+            t = threading.Thread(target=self._handle_client, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _handle_client(self, conn) -> None:
+        stream = JsonStream(conn)
+        try:
+            while self.running:
+                objs = stream.recv_objects()
+                if objs is None:
+                    break
+                for req in objs:
+                    self._dispatch(conn, req)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, conn, req: dict) -> None:
+        rtype = req.get("type")
+        if rtype == "register":
+            peer = PeerInfo(req["ip"], int(req["port"]), time.time())
+            self.add_peer(peer)
+            send_json(conn, {
+                "type": "peer_list",
+                "peers": [p.to_json() for p in self.get_peer_list()],
+            })
+            self.log.log(f"Registered new peer: {peer.ip}:{peer.port}")
+        elif rtype == "dead_node":
+            self.handle_dead_node(req["dead_ip"], int(req["dead_port"]))
+            self.log.log("Received dead node notification for: "
+                         f"{req['dead_ip']}:{req['dead_port']}")

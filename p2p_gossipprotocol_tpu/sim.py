@@ -1,0 +1,213 @@
+"""Simulator: the whole reference network as one jitted scan loop.
+
+Where the reference runs n processes × 4 threads each, blocking on sockets
+(SURVEY.md §3.1), the simulator advances every peer in lockstep: one
+``lax.scan`` step = one gossip round = one message_interval tick of the
+reference's wall-clock.  Per-round metrics (coverage, frontier size, live
+peers, deliveries, evictions) are the scan's ``ys`` — the structured
+observability the reference lacks (SURVEY §5).
+
+Two execution paths:
+  * :meth:`Simulator.run` — fixed-round ``lax.scan``, full metric history.
+  * :meth:`Simulator.run_to_coverage` — ``lax.while_loop`` that stops at a
+    target coverage, for benchmarking time-to-99%.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2p_gossipprotocol_tpu import graph as graph_lib
+from p2p_gossipprotocol_tpu.graph import Topology
+from p2p_gossipprotocol_tpu.liveness import (ChurnConfig, churn_step,
+                                             strike_and_rewire)
+from p2p_gossipprotocol_tpu.models.byzantine import inject_byzantine
+from p2p_gossipprotocol_tpu.models.gossip import make_round_fn
+from p2p_gossipprotocol_tpu.state import GossipState, init_gossip_state
+
+
+def coverage_of(state: GossipState, n_honest: int | None = None
+                ) -> jax.Array:
+    """Mean over (honest) message columns of the fraction of live honest
+    peers that have seen the message."""
+    ok = state.alive & ~state.byzantine
+    denom = jnp.maximum(jnp.sum(ok, dtype=jnp.int32), 1)
+    per_msg = jnp.sum(state.seen & ok[:, None], axis=0,
+                      dtype=jnp.int32) / denom
+    if n_honest is not None and n_honest < state.n_msgs:
+        per_msg = per_msg[:n_honest]
+    return jnp.mean(per_msg)
+
+
+@dataclass
+class SimResult:
+    """Host-side results of a run."""
+
+    state: GossipState
+    topo: Topology
+    coverage: np.ndarray       # float32[rounds]
+    deliveries: np.ndarray     # int32[rounds]
+    frontier_size: np.ndarray  # int32[rounds]
+    live_peers: np.ndarray     # int32[rounds]
+    evictions: np.ndarray      # int32[rounds]
+    wall_s: float = 0.0
+
+    def rounds_to(self, target: float = 0.99) -> int:
+        """First 1-indexed round reaching target coverage, or -1."""
+        hit = np.nonzero(self.coverage >= target)[0]
+        return int(hit[0]) + 1 if hit.size else -1
+
+    @property
+    def total_deliveries(self) -> int:
+        return int(self.deliveries.sum())
+
+
+@dataclass
+class Simulator:
+    """Owns a topology + round semantics; state flows through functionally.
+
+    Parameters mirror the config system: ``mode`` (push|pull|pushpull,
+    push being the reference's semantics), ``fanout`` (0 = flood, the
+    reference's broadcast), churn/byzantine knobs, and the liveness
+    3-strike rule (max_missed_pings, honored from config unlike the
+    reference — SURVEY §2-C2).
+    """
+
+    topo: Topology
+    n_msgs: int = 16
+    mode: str = "push"
+    fanout: int = 0
+    churn: ChurnConfig = field(default_factory=ChurnConfig)
+    byzantine_fraction: float = 0.0
+    n_honest_msgs: int | None = None   # None → all columns honest
+    max_strikes: int = 3
+    rewire: bool = True
+    seed: int = 0
+
+    def __post_init__(self):
+        self._round_fn = make_round_fn(self.mode, self.fanout)
+        self._n_honest = (self.n_honest_msgs
+                          if self.n_honest_msgs is not None else self.n_msgs)
+
+    # ------------------------------------------------------------------
+    def init_state(self, sources=None) -> GossipState:
+        key = jax.random.PRNGKey(self.seed)
+        return init_gossip_state(self.topo, self.n_msgs, key,
+                                 sources=sources,
+                                 byzantine_fraction=self.byzantine_fraction,
+                                 n_honest_msgs=self._n_honest)
+
+    # ------------------------------------------------------------------
+    def step(self, state: GossipState, topo: Topology
+             ) -> tuple[GossipState, Topology, dict]:
+        """One full round: churn → liveness/rewire → (byz inject) → gossip."""
+        key, k_churn, k_rewire = jax.random.split(state.key, 3)
+        state = state.replace(key=key)
+        alive = churn_step(k_churn, state.alive, state.round, self.churn)
+        state = state.replace(alive=alive)
+        topo, strikes, n_evict = strike_and_rewire(
+            k_rewire, topo, state.edge_strikes, alive,
+            max_strikes=self.max_strikes, rewire=self.rewire)
+        state = state.replace(edge_strikes=strikes)
+        if self._n_honest < self.n_msgs:
+            state = inject_byzantine(state, self._n_honest)
+        state, deliveries = self._round_fn(state, topo)
+        metrics = {
+            "coverage": coverage_of(state, self._n_honest),
+            "deliveries": deliveries,
+            "frontier_size": jnp.sum(state.frontier, dtype=jnp.int32),
+            "live_peers": jnp.sum(state.alive, dtype=jnp.int32),
+            "evictions": n_evict,
+        }
+        return state, topo, metrics
+
+    # ------------------------------------------------------------------
+    def run(self, rounds: int, state: GossipState | None = None,
+            topo: Topology | None = None) -> SimResult:
+        """Fixed-round scan with full metric history."""
+        import time as _time
+
+        state = self.init_state() if state is None else state
+        topo = self.topo if topo is None else topo
+
+        def body(carry, _):
+            st, tp = carry
+            st, tp, metrics = self.step(st, tp)
+            return (st, tp), metrics
+
+        @jax.jit
+        def go(st, tp):
+            return jax.lax.scan(body, (st, tp), None, length=rounds)
+
+        t0 = _time.perf_counter()
+        (state, topo), ys = go(state, topo)
+        jax.block_until_ready(state.seen)
+        wall = _time.perf_counter() - t0
+        return SimResult(
+            state=state, topo=topo,
+            coverage=np.asarray(ys["coverage"]),
+            deliveries=np.asarray(ys["deliveries"]),
+            frontier_size=np.asarray(ys["frontier_size"]),
+            live_peers=np.asarray(ys["live_peers"]),
+            evictions=np.asarray(ys["evictions"]),
+            wall_s=wall,
+        )
+
+    # ------------------------------------------------------------------
+    def run_to_coverage(self, target: float = 0.99, max_rounds: int = 256,
+                        state: GossipState | None = None
+                        ) -> tuple[GossipState, Topology, int, float]:
+        """while_loop until coverage ≥ target; returns
+        (state, topo, rounds_run, wall_seconds).  This is the benchmark
+        path (BASELINE north star: 1M peers to 99% in < 2 s)."""
+        import time as _time
+
+        state = self.init_state() if state is None else state
+
+        def cond(carry):
+            st, tp, cov = carry
+            return (cov < target) & (st.round < max_rounds)
+
+        def body(carry):
+            st, tp, _ = carry
+            st, tp, metrics = self.step(st, tp)
+            return st, tp, metrics["coverage"]
+
+        @jax.jit
+        def go(st, tp):
+            return jax.lax.while_loop(cond, body, (st, tp, jnp.float32(0)))
+
+        # compile first (compile time excluded from the timed run)
+        go_c = go.lower(state, self.topo).compile()
+        t0 = _time.perf_counter()
+        st, tp, cov = go_c(state, self.topo)
+        jax.block_until_ready(st.seen)
+        wall = _time.perf_counter() - t0
+        return st, tp, int(st.round), wall
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_config(cls, cfg, n_peers: int | None = None) -> "Simulator":
+        """Build simulator + overlay from a :class:`NetworkConfig`."""
+        topo = graph_lib.from_config(cfg, n_peers=n_peers)
+        n_msgs = cfg.n_messages or cfg.max_message_count
+        n_junk = 0
+        if cfg.byzantine_fraction > 0.0:
+            n_junk = max(1, n_msgs // 4)
+        churn = ChurnConfig(rate=cfg.churn_rate) if cfg.churn_rate else \
+            ChurnConfig()
+        return cls(
+            topo=topo,
+            n_msgs=n_msgs + n_junk,
+            mode=cfg.mode,
+            fanout=cfg.fanout,
+            churn=churn,
+            byzantine_fraction=cfg.byzantine_fraction,
+            n_honest_msgs=n_msgs if n_junk else None,
+            max_strikes=cfg.max_missed_pings,
+            seed=cfg.prng_seed,
+        )

@@ -1,0 +1,130 @@
+"""Simulation state pytrees.
+
+The reference's mutable, mutex-guarded per-process state (``connectedPeers``
+/ ``messageList`` / ``pingStatus``, peer.hpp:48-62) becomes one immutable
+pytree threaded through ``lax.scan`` — no threads, no locks, no data races
+by construction (SURVEY.md §5 race-detection note).
+
+State-to-reference map:
+  * ``seen[p, m]``      — peer p has processed message m.  This is the
+    vectorization of every peer's ``messageList`` dedup map
+    (peer.cpp:280-286): membership test = one bool load.
+  * ``frontier[p, m]``  — p received m *last round* and will relay it this
+    round.  Encodes the reference's flood-once semantics: a peer broadcasts
+    a message exactly once, on first receipt (peer.cpp:281-284).
+  * ``alive[p]``        — liveness mask; the vectorized ping/eviction layer
+    (peer.cpp:320-355) updates it instead of ICMP.
+  * ``byzantine[p]``    — adversarial peers (BASELINE.json config 5): they
+    receive but never relay, and inject junk messages.
+  * ``edge_strikes[e]`` — consecutive rounds edge e's dst was observed dead;
+    the vectorized 3-strike rule (peer.cpp:335-339).
+  * ``key`` / ``round`` — PRNG chain and round counter (replaces wall-clock
+    timers; one round ≈ one message_interval tick).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from p2p_gossipprotocol_tpu.graph import Topology
+
+
+@struct.dataclass
+class GossipState:
+    seen: jax.Array          # bool[n_peers, n_msgs]
+    frontier: jax.Array      # bool[n_peers, n_msgs]
+    alive: jax.Array         # bool[n_peers]
+    byzantine: jax.Array     # bool[n_peers]
+    edge_strikes: jax.Array  # int32[E_cap]
+    key: jax.Array           # PRNGKey
+    round: jax.Array         # int32 scalar
+
+    @property
+    def n_peers(self) -> int:
+        return self.seen.shape[0]
+
+    @property
+    def n_msgs(self) -> int:
+        return self.seen.shape[1]
+
+
+def init_gossip_state(topo: Topology, n_msgs: int, key: jax.Array,
+                      sources: jax.Array | None = None,
+                      byzantine_fraction: float = 0.0,
+                      n_honest_msgs: int | None = None) -> GossipState:
+    """Fresh state: message j originates at peer ``sources[j]``.
+
+    Default source placement spreads rumors evenly over the HONEST peer
+    population — the analogue of every reference peer generating its own
+    messages (messageGenerationLoop, peer.cpp:357-379) with the message
+    count bounded like the reference's 10-message cap (peer.cpp:358).
+    Honest rumors must originate at honest peers (a byzantine source never
+    relays, so its rumor would be stillborn — not the scenario the
+    Byzantine config measures).  Columns ≥ ``n_honest_msgs`` are the
+    adversary's injection budget and start empty.
+    """
+    n = topo.n_peers
+    k_src, k_byz, k_run = jax.random.split(key, 3)
+    n_honest = n_msgs if n_honest_msgs is None else n_honest_msgs
+    if byzantine_fraction > 0.0:
+        byz = jax.random.uniform(k_byz, (n,)) < byzantine_fraction
+    else:
+        byz = jnp.zeros(n, bool)
+    if sources is None:
+        honest_idx = jnp.nonzero(~byz, size=n, fill_value=0)[0]
+        n_honest_peers = jnp.maximum(jnp.sum(~byz, dtype=jnp.int32), 1)
+        stride = jnp.maximum(n_honest_peers // max(n_honest, 1), 1)
+        pos = (jnp.arange(n_msgs, dtype=jnp.int32) * stride) % n_honest_peers
+        sources = honest_idx[pos]
+    col = jnp.arange(n_msgs)
+    place = col < n_honest
+    seen = jnp.zeros((n, n_msgs), bool).at[
+        jnp.where(place, sources, 0), col].max(place)
+    return GossipState(
+        seen=seen,
+        frontier=seen,
+        alive=jnp.ones(n, bool),
+        byzantine=byz,
+        edge_strikes=jnp.zeros(topo.edge_capacity, jnp.int32),
+        key=k_run,
+        round=jnp.int32(0),
+    )
+
+
+@struct.dataclass
+class SIRState:
+    """SIR epidemic state (BASELINE.json config 3): one compartment per
+    peer.  0 = susceptible, 1 = infected, 2 = recovered."""
+
+    compartment: jax.Array   # int8[n_peers]
+    alive: jax.Array         # bool[n_peers]
+    key: jax.Array
+    round: jax.Array
+
+    @property
+    def n_peers(self) -> int:
+        return self.compartment.shape[0]
+
+    @property
+    def susceptible(self) -> jax.Array:
+        return self.compartment == 0
+
+    @property
+    def infected(self) -> jax.Array:
+        return self.compartment == 1
+
+    @property
+    def recovered(self) -> jax.Array:
+        return self.compartment == 2
+
+
+def init_sir_state(topo: Topology, key: jax.Array,
+                   n_seeds: int = 1) -> SIRState:
+    n = topo.n_peers
+    k_src, k_run = jax.random.split(key)
+    idx = jax.random.choice(k_src, n, (max(1, n_seeds),), replace=False)
+    comp = jnp.zeros(n, jnp.int8).at[idx].set(1)
+    return SIRState(compartment=comp, alive=jnp.ones(n, bool),
+                    key=k_run, round=jnp.int32(0))

@@ -1,0 +1,19 @@
+"""Transport layer: the seam SURVEY.md §1 prescribes between gossip
+semantics and message delivery.
+
+* :class:`JaxTransport` — delivery as masked OR-scatter over the HBM
+  adjacency (the TPU path; what Simulator uses).
+* :class:`SocketTransport` + :class:`JsonStream` — real TCP speaking the
+  reference's unframed-JSON wire format for small-n interop.
+"""
+
+from p2p_gossipprotocol_tpu.transport.base import Transport
+from p2p_gossipprotocol_tpu.transport.jax_transport import JaxTransport
+from p2p_gossipprotocol_tpu.transport.socket_transport import (
+    JsonStream,
+    SocketTransport,
+    send_json,
+)
+
+__all__ = ["Transport", "JaxTransport", "SocketTransport", "JsonStream",
+           "send_json"]

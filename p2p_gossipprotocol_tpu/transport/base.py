@@ -1,0 +1,22 @@
+"""Abstract transport interface.
+
+The reference hard-wires BSD sockets into the gossip logic
+(peer.cpp:30-58, 161-173); here delivery is pluggable — the same gossip
+semantics run over TCP (interop) or over the TPU adjacency (simulation).
+"""
+
+from __future__ import annotations
+
+import abc
+
+
+class Transport(abc.ABC):
+    """Delivers gossip payloads between peers."""
+
+    @abc.abstractmethod
+    def start(self) -> None:
+        """Bring the transport up (bind/listen, or allocate device state)."""
+
+    @abc.abstractmethod
+    def stop(self) -> None:
+        """Tear the transport down."""

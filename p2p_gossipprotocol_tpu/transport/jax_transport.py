@@ -1,0 +1,34 @@
+"""JaxTransport: message delivery as a masked OR-scatter over the
+fixed-capacity adjacency in HBM — the TPU-native replacement for the
+reference's per-socket ``send``/``recv`` (SURVEY.md §2 native-equivalents
+table, row 1).
+
+One ``deliver`` call moves every in-flight message across every live edge
+simultaneously; there are no connections, buffers, or partial reads to
+manage.  The Simulator composes this with dedup/liveness; the class exists
+so transports stay swappable at the API seam.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from p2p_gossipprotocol_tpu.graph import Topology
+from p2p_gossipprotocol_tpu.ops.propagate import edge_or_scatter
+from p2p_gossipprotocol_tpu.transport.base import Transport
+
+
+class JaxTransport(Transport):
+    def __init__(self, topo: Topology):
+        self.topo = topo
+
+    def start(self) -> None:  # nothing to bring up: state lives in HBM
+        pass
+
+    def stop(self) -> None:
+        pass
+
+    def deliver(self, sending: jax.Array,
+                edge_gate: jax.Array | None = None) -> jax.Array:
+        """bool[n, m] of transmissions → bool[n, m] of receptions."""
+        return edge_or_scatter(sending, self.topo, edge_gate)

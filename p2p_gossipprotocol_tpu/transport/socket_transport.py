@@ -1,0 +1,107 @@
+"""TCP transport speaking the reference's wire format.
+
+The reference sends bare ``json.dump()`` bytes with NO framing and parses
+whatever one 4 KB ``recv`` returns as a complete document
+(peer.cpp:182-194, 256-265; seed.cpp:93-107) — which breaks the moment TCP
+coalesces or fragments (SURVEY.md §2-C7).  :class:`JsonStream` stays
+byte-compatible on the SEND side (identical payloads) while fixing the
+receive side: it accumulates a buffer and peels off complete JSON
+documents with an incremental decoder, so back-to-back reference messages
+that arrive coalesced are split correctly instead of crashing the parser.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+
+from p2p_gossipprotocol_tpu.transport.base import Transport
+
+RECV_SIZE = 4096  # reference buffer size (peer.cpp:188)
+_DECODER = json.JSONDecoder()
+
+
+def send_json(sock: socket.socket, obj: dict) -> None:
+    """Reference-identical send: compact JSON, no frame, no newline
+    (peer.cpp:182, json.dump default separators match nlohmann dump())."""
+    sock.sendall(json.dumps(obj, separators=(",", ":")).encode())
+
+
+class JsonStream:
+    """Incremental JSON document splitter over a byte stream."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self._buf = ""
+
+    def recv_objects(self) -> list[dict] | None:
+        """Block for one recv; return parsed docs (possibly several, or
+        none yet) — or None on EOF."""
+        try:
+            chunk = self.sock.recv(RECV_SIZE)
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        self._buf += chunk.decode(errors="replace")
+        out = []
+        while True:
+            s = self._buf.lstrip()
+            if not s:
+                self._buf = ""
+                break
+            try:
+                obj, end = _DECODER.raw_decode(s)
+            except json.JSONDecodeError:
+                self._buf = s  # incomplete document: wait for more bytes
+                break
+            out.append(obj)
+            self._buf = s[end:]
+        return out
+
+
+class SocketTransport(Transport):
+    """Listening socket + connection bookkeeping for a socket-mode node.
+
+    Mirrors the reference's listen setup: SO_REUSEADDR, backlog 10
+    (peer.cpp:30-58, seed.cpp:27-55).
+    """
+
+    BACKLOG = 10
+
+    def __init__(self, ip: str, port: int):
+        self.ip = ip
+        self.port = port
+        self.listener: socket.socket | None = None
+
+    def start(self) -> None:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self.ip, self.port))
+        s.listen(self.BACKLOG)
+        self.listener = s
+
+    def accept(self, timeout: float | None = None):
+        assert self.listener is not None, "start() first"
+        self.listener.settimeout(timeout)
+        try:
+            conn, addr = self.listener.accept()
+            return conn, addr
+        except (socket.timeout, OSError):
+            return None, None
+
+    @staticmethod
+    def connect(ip: str, port: int, timeout: float = 2.0
+                ) -> socket.socket | None:
+        try:
+            return socket.create_connection((ip, port), timeout=timeout)
+        except OSError:
+            return None
+
+    def stop(self) -> None:
+        if self.listener is not None:
+            try:
+                self.listener.close()
+            except OSError:
+                pass
+            self.listener = None

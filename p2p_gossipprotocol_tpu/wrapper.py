@@ -1,0 +1,92 @@
+"""Lifecycle facade — the reference's ``Peer`` wrapper (wrapper.hpp:7-19)
+generalized over backends.
+
+``Peer(config_file)`` parses the config ONCE (the reference re-parses it a
+second time inside the wrapper, wrapper.cpp:3 vs main.cpp:46 — SURVEY
+§3.1) and dispatches on ``backend``:
+
+* ``socket`` — a real :class:`PeerNode` speaking TCP (n-terminal mode);
+* ``jax``    — the whole network as one TPU simulation (Simulator), run on
+  a background thread so start/stop/is_running keep their reference
+  semantics.
+
+All parsed tuning params are plumbed through — the fix for the reference
+dropping them on the floor (wrapper.cpp:10-14, SURVEY §2-C2).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from p2p_gossipprotocol_tpu.config import NetworkConfig
+from p2p_gossipprotocol_tpu.info import PeerInfo
+
+
+class Peer:
+    """start()/stop()/is_running() facade (wrapper.hpp:7-19)."""
+
+    def __init__(self, config_file: str,
+                 config: NetworkConfig | None = None):
+        self.config = config or NetworkConfig(config_file)
+        cfg = self.config
+        self._backend = cfg.backend
+        self._thread: threading.Thread | None = None
+        self._result = None
+        if cfg.backend == "socket":
+            from p2p_gossipprotocol_tpu.peer import PeerNode
+
+            seeds = [PeerInfo(n.ip, n.port) for n in cfg.get_seed_nodes()]
+            self.node = PeerNode(
+                cfg.get_local_ip(), cfg.get_local_port(), seeds,
+                ping_interval=cfg.get_ping_interval(),
+                message_interval=cfg.get_message_interval(),
+                max_messages=cfg.get_max_messages(),
+                max_missed_pings=cfg.get_max_missed_pings(),
+                powerlaw_alpha=cfg.powerlaw_alpha,
+            )
+        else:
+            from p2p_gossipprotocol_tpu.sim import Simulator
+
+            self.node = None
+            self._sim = Simulator.from_config(cfg)
+            self._running = False
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> bool:
+        if self._backend == "socket":
+            return self.node.start()
+        rounds = self.config.rounds or 64
+
+        def _run():
+            self._result = self._sim.run(rounds)
+            self._running = False
+
+        self._running = True
+        self._thread = threading.Thread(target=_run, daemon=True)
+        self._thread.start()
+        return True
+
+    def stop(self) -> None:
+        if self._backend == "socket":
+            self.node.stop()
+        else:
+            self._running = False  # scan finishes; result kept if complete
+
+    def is_running(self) -> bool:
+        if self._backend == "socket":
+            return self.node.is_running()
+        return self._running
+
+    # -- jax-backend extras --------------------------------------------
+    def join(self, timeout: float | None = None):
+        if self._thread is not None:
+            self._thread.join(timeout)
+        return self._result
+
+    @property
+    def result(self):
+        return self._result
+
+    @property
+    def simulator(self):
+        return getattr(self, "_sim", None)

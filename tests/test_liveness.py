@@ -1,0 +1,95 @@
+"""Liveness/churn tests: 3-strike eviction, rewiring, churn schedules,
+and end-to-end recovery (the reference's signature feature, SURVEY §5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2p_gossipprotocol_tpu import graph as G
+from p2p_gossipprotocol_tpu.liveness import (ChurnConfig, churn_step,
+                                             strike_and_rewire)
+from p2p_gossipprotocol_tpu.sim import Simulator
+
+
+def test_strikes_accumulate_and_reset():
+    topo = G.erdos_renyi(0, 32, avg_degree=4)
+    n = topo.n_peers
+    alive = jnp.ones(n, bool).at[3].set(False)
+    strikes = jnp.zeros(topo.edge_capacity, jnp.int32)
+    key = jax.random.PRNGKey(0)
+    topo2, strikes, _ = strike_and_rewire(key, topo, strikes, alive,
+                                          rewire=False)
+    to_dead = np.asarray(topo.edge_mask) & (np.asarray(topo.dst) == 3)
+    assert (np.asarray(strikes)[to_dead] == 1).all()
+    assert (np.asarray(strikes)[~to_dead] == 0).all()
+    # revive: counters reset (reference resets failedPings on success)
+    alive = jnp.ones(n, bool)
+    _, strikes, _ = strike_and_rewire(key, topo2, strikes, alive,
+                                      rewire=False)
+    assert (np.asarray(strikes) == 0).all()
+
+
+def test_eviction_after_max_strikes_no_rewire():
+    topo = G.erdos_renyi(1, 32, avg_degree=4)
+    alive = jnp.ones(32, bool).at[5].set(False)
+    strikes = jnp.zeros(topo.edge_capacity, jnp.int32)
+    key = jax.random.PRNGKey(0)
+    n_ev = 0
+    for i in range(4):
+        topo, strikes, ev = strike_and_rewire(key, topo, strikes, alive,
+                                              max_strikes=3, rewire=False)
+        n_ev += int(ev)
+    mask = np.asarray(topo.edge_mask)
+    dst = np.asarray(topo.dst)
+    assert not (mask & (dst == 5)).any()  # all edges to the dead peer gone
+    assert n_ev > 0
+
+
+def test_rewire_replaces_dead_dst_with_live_peer():
+    topo = G.erdos_renyi(2, 64, avg_degree=6)
+    alive = jnp.ones(64, bool).at[7].set(False)
+    strikes = jnp.zeros(topo.edge_capacity, jnp.int32)
+    had_edges_to_7 = (np.asarray(topo.edge_mask)
+                      & (np.asarray(topo.dst) == 7)).sum()
+    assert had_edges_to_7 > 0
+    for i in range(8):
+        topo, strikes, _ = strike_and_rewire(
+            jax.random.PRNGKey(i), topo, strikes, alive, max_strikes=3)
+    mask = np.asarray(topo.edge_mask)
+    dst = np.asarray(topo.dst)
+    src = np.asarray(topo.src)
+    assert not (mask & (dst == 7)).any()   # dead dst fully rewired away
+    assert mask.sum() == np.asarray(G.erdos_renyi(2, 64, avg_degree=6)
+                                    .edge_mask).sum()  # capacity preserved
+    assert (src[mask] != dst[mask]).all()  # rewiring never creates self-loops
+
+
+def test_churn_one_shot_kill():
+    key = jax.random.PRNGKey(0)
+    alive = jnp.ones(10_000, bool)
+    cfg = ChurnConfig(rate=0.05, kill_round=3)
+    a = churn_step(key, alive, jnp.int32(2), cfg)
+    assert int(a.sum()) == 10_000           # not the kill round yet
+    a = churn_step(key, alive, jnp.int32(3), cfg)
+    frac = 1.0 - int(a.sum()) / 10_000
+    assert 0.03 < frac < 0.07               # ≈5% died
+
+
+def test_churn_continuous_and_revive():
+    key = jax.random.PRNGKey(1)
+    alive = jnp.zeros(10_000, bool)
+    cfg = ChurnConfig(rate=0.0, revive=0.5)
+    a = churn_step(key, alive, jnp.int32(0), cfg)
+    assert 0.4 < int(a.sum()) / 10_000 < 0.6
+
+
+def test_gossip_survives_churn_end_to_end():
+    """5%-churn config: coverage still reaches ~full among live peers —
+    the vectorized version of the README's Ctrl-C recovery demo."""
+    topo = G.erdos_renyi(3, 1024, avg_degree=8)
+    sim = Simulator(topo, n_msgs=4, mode="pushpull",
+                    churn=ChurnConfig(rate=0.05, kill_round=2), seed=42)
+    res = sim.run(40)
+    assert res.live_peers[-1] < 1024
+    assert res.coverage[-1] > 0.99
+    assert res.rounds_to(0.99) > 0
