@@ -203,7 +203,12 @@ def from_config(cfg, n_peers: int | None = None) -> Topology:
     n = n_peers or cfg.n_peers or len(cfg.seed_nodes)
     g = cfg.graph
     if g in ("reference", "powerlaw"):
-        cap = None if g == "reference" and n <= 100_000 else max(
+        # The raw reference law has E[degree] ≈ 0.71·n (E[u^(1/2.5)] = 1/1.4,
+        # peer.cpp:219-222) — quadratic edge growth.  Leave it uncapped only
+        # at reference-like scales (tens of peers per seed list,
+        # network.txt:1-20); beyond that cap per-peer fanout so edge count
+        # stays linear in n.
+        cap = None if g == "reference" and n <= 2048 else max(
             64, cfg.avg_degree * 8)
         return reference_powerlaw(cfg.prng_seed, n, alpha=cfg.powerlaw_alpha,
                                   max_degree=cap)

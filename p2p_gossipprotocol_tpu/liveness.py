@@ -75,7 +75,10 @@ def strike_and_rewire(key: jax.Array, topo: Topology, strikes: jax.Array,
     dst_dead = topo.edge_mask & ~alive[topo.dst]
     strikes = jnp.where(dst_dead, strikes + 1, 0)
     evict = strikes >= max_strikes
-    n_evict = jnp.sum(evict, dtype=jnp.int32)
+    # Count an eviction only the round the threshold is first crossed —
+    # an edge stuck waiting for a live rewire candidate keeps evict=True
+    # but is one eviction, not one per round.
+    n_evict = jnp.sum(strikes == max_strikes, dtype=jnp.int32)
     if not rewire:
         new_mask = topo.edge_mask & ~evict
         return (topo.replace(edge_mask=new_mask),

@@ -1,0 +1,34 @@
+"""Multi-chip execution: the peer axis sharded over a TPU mesh.
+
+The reference scales by launching more processes on more terminals
+(reference README.md:4) wired with point-to-point TCP; here the same
+network scales by sharding every per-peer state array over a
+``jax.sharding.Mesh`` and letting XLA turn the cross-shard edges of the
+dissemination scatter into ICI collectives (SURVEY.md §2, parallelism
+table).  Data parallelism over *peers* is the one parallelism axis the
+capability set needs; message-axis sharding is the nearest analogue of
+sequence parallelism and can be layered on the same mesh.
+
+Modules:
+  mesh       — mesh construction helpers
+  partition  — host-side topology partitioning into per-shard edge blocks
+  sharded_sim — ShardedSimulator: the whole scan loop under shard_map
+"""
+
+from p2p_gossipprotocol_tpu.parallel.mesh import make_mesh
+from p2p_gossipprotocol_tpu.parallel.partition import (
+    ShardedTopology,
+    partition_topology,
+    shard_state,
+    unshard_state,
+)
+from p2p_gossipprotocol_tpu.parallel.sharded_sim import ShardedSimulator
+
+__all__ = [
+    "make_mesh",
+    "ShardedTopology",
+    "partition_topology",
+    "shard_state",
+    "unshard_state",
+    "ShardedSimulator",
+]

@@ -1,0 +1,35 @@
+"""Mesh construction helpers.
+
+One logical axis, ``"peers"``, carries all sharding in this framework —
+the peer dimension of state arrays and the edge dimension of the
+partitioned overlay both map onto it (edges live with the shard that owns
+their source peer, so the dissemination gather is local and only the
+scatter crosses shards).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+PEER_AXIS = "peers"
+
+
+def make_mesh(n_devices: int | None = None,
+              devices: list | None = None) -> Mesh:
+    """A 1-D mesh over ``n_devices`` (default: all available devices).
+
+    The real-hardware layout (v5e-8, v5e-64, multi-slice) and the virtual
+    CPU test layout (``--xla_force_host_platform_device_count``) go through
+    the same path; XLA routes the collectives over ICI within a slice and
+    DCN across slices on its own.
+    """
+    devs = devices if devices is not None else jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (PEER_AXIS,))

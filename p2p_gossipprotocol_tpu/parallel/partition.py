@@ -1,0 +1,164 @@
+"""Host-side partitioning of the overlay + state for the peer-axis mesh.
+
+Peers are split into ``n_shards`` contiguous blocks; each shard owns the
+out-edges of its peers (a contiguous slice of the globally src-sorted edge
+list, since `graph._pad_and_build` sorts by src), padded to a uniform
+per-shard capacity so the stacked arrays have static shapes.  This is the
+sharded form of SURVEY.md §7 hard part (b): churn and rewiring mutate
+``dst``/``edge_mask`` in place; nothing is ever re-materialized.
+
+Owning edges by *source* keeps the hot-path gather (``frontier[src]``)
+shard-local; only the delivery scatter crosses shards (one
+``psum_scatter`` per round — the ICI collective that replaces the
+reference's per-message TCP sends, peer.cpp:310-312).
+
+``gidx`` maps each local edge slot back to its global edge index so that
+per-edge randomness can be drawn *globally* (from the replicated key) and
+gathered locally — making every random decision bitwise-invariant to the
+shard count, which is what lets the 1-vs-N-device determinism tests
+(SURVEY.md §4) demand exact equality.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from p2p_gossipprotocol_tpu.graph import Topology
+from p2p_gossipprotocol_tpu.parallel.mesh import PEER_AXIS
+from p2p_gossipprotocol_tpu.state import GossipState
+
+
+@struct.dataclass
+class ShardedTopology:
+    """Per-shard overlay blocks, flattened so axis 0 shards over the mesh.
+
+    Layout (S = n_shards, B = block = n_pad/S, E = e_shard):
+      * ``src``/``dst``/``edge_mask``/``gidx``: [S*E]; shard s's slice holds
+        the out-edges of peers [s*B, (s+1)*B), src/dst as GLOBAL peer ids.
+        Padded slots have ``edge_mask=False``.
+      * ``row_ptr``: [S*(B+1)] local CSR offsets — shard s's slice indexes
+        into its own edge block, for O(1) neighbor sampling.
+    ``dst``/``edge_mask`` are mutable state (rewiring); the rest is fixed.
+    """
+
+    src: jax.Array        # int32[S*E]
+    dst: jax.Array        # int32[S*E]
+    edge_mask: jax.Array  # bool[S*E]
+    gidx: jax.Array       # int32[S*E]  global edge index (RNG alignment)
+    row_ptr: jax.Array    # int32[S*(B+1)]
+    n_peers: int = struct.field(pytree_node=False)
+    n_pad: int = struct.field(pytree_node=False)
+    block: int = struct.field(pytree_node=False)
+    e_shard: int = struct.field(pytree_node=False)
+    e_gcap: int = struct.field(pytree_node=False)
+    n_shards: int = struct.field(pytree_node=False)
+
+    def spec(self) -> "ShardedTopology":
+        """PartitionSpec tree matching this pytree (for shard_map)."""
+        return self.replace(src=P(PEER_AXIS), dst=P(PEER_AXIS),
+                            edge_mask=P(PEER_AXIS), gidx=P(PEER_AXIS),
+                            row_ptr=P(PEER_AXIS))
+
+
+def partition_topology(topo: Topology, n_shards: int,
+                       pad_multiple: int = 8) -> ShardedTopology:
+    """Split a global :class:`Topology` into per-shard blocks (host NumPy —
+    one-time setup, like graph construction itself)."""
+    n = topo.n_peers
+    src = np.asarray(topo.src)
+    dst = np.asarray(topo.dst)
+    mask = np.asarray(topo.edge_mask)
+    row_ptr = np.asarray(topo.row_ptr)
+
+    block = -(-n // n_shards)
+    n_pad = block * n_shards
+
+    lo_e = np.empty(n_shards, np.int64)
+    hi_e = np.empty(n_shards, np.int64)
+    for s in range(n_shards):
+        lo = min(s * block, n)
+        hi = min((s + 1) * block, n)
+        lo_e[s] = row_ptr[lo]
+        hi_e[s] = row_ptr[hi]
+    counts = hi_e - lo_e
+    e_shard = max(pad_multiple,
+                  int(-(-max(1, counts.max()) // pad_multiple))
+                  * pad_multiple)
+
+    s_src = np.zeros((n_shards, e_shard), np.int32)
+    s_dst = np.zeros((n_shards, e_shard), np.int32)
+    s_mask = np.zeros((n_shards, e_shard), bool)
+    s_gidx = np.zeros((n_shards, e_shard), np.int32)
+    s_rp = np.zeros((n_shards, block + 1), np.int32)
+    for s in range(n_shards):
+        c = int(counts[s])
+        sl = slice(int(lo_e[s]), int(hi_e[s]))
+        s_src[s, :c] = src[sl]
+        s_dst[s, :c] = dst[sl]
+        s_mask[s, :c] = mask[sl]
+        s_gidx[s, :c] = np.arange(lo_e[s], hi_e[s], dtype=np.int32)
+        lo = min(s * block, n)
+        hi = min((s + 1) * block, n)
+        width = hi - lo
+        s_rp[s, :width + 1] = row_ptr[lo:hi + 1] - row_ptr[lo]
+        if width < block:  # padding peers: degree-0 rows
+            s_rp[s, width + 1:] = s_rp[s, width]
+
+    return ShardedTopology(
+        src=jnp.asarray(s_src.reshape(-1)),
+        dst=jnp.asarray(s_dst.reshape(-1)),
+        edge_mask=jnp.asarray(s_mask.reshape(-1)),
+        gidx=jnp.asarray(s_gidx.reshape(-1)),
+        row_ptr=jnp.asarray(s_rp.reshape(-1)),
+        n_peers=n, n_pad=n_pad, block=block, e_shard=e_shard,
+        e_gcap=topo.edge_capacity, n_shards=n_shards,
+    )
+
+
+def state_spec() -> GossipState:
+    """PartitionSpec tree for a sharded :class:`GossipState` (peer-axis
+    leaves sharded; PRNG key and round counter replicated)."""
+    return GossipState(
+        seen=P(PEER_AXIS, None), frontier=P(PEER_AXIS, None),
+        alive=P(PEER_AXIS), byzantine=P(PEER_AXIS),
+        edge_strikes=P(PEER_AXIS), key=P(), round=P())
+
+
+def shard_state(state: GossipState, stopo: ShardedTopology,
+                mesh) -> GossipState:
+    """Pad a globally-initialized state to ``n_pad`` peers and lay it out
+    on the mesh.  Padding peers are dead (``alive=False``) so they never
+    send, receive, or count toward coverage.  ``edge_strikes`` is re-laid
+    out to the per-shard edge capacity (fresh zeros — strikes are
+    transient liveness observations, always zero at init)."""
+    pad = stopo.n_pad - state.n_peers
+    padded = state.replace(
+        seen=jnp.pad(state.seen, ((0, pad), (0, 0))),
+        frontier=jnp.pad(state.frontier, ((0, pad), (0, 0))),
+        alive=jnp.pad(state.alive, (0, pad)),
+        byzantine=jnp.pad(state.byzantine, (0, pad)),
+        edge_strikes=jnp.zeros(stopo.n_shards * stopo.e_shard, jnp.int32),
+    )
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), state_spec())
+    return jax.device_put(padded, shardings)
+
+
+def unshard_state(state: GossipState, stopo: ShardedTopology) -> GossipState:
+    """Back to a host-side global view with padding peers stripped (the
+    per-shard ``edge_strikes`` layout is kept — it only means anything
+    against the sharded topology)."""
+    n = stopo.n_peers
+    return GossipState(
+        seen=jnp.asarray(np.asarray(state.seen)[:n]),
+        frontier=jnp.asarray(np.asarray(state.frontier)[:n]),
+        alive=jnp.asarray(np.asarray(state.alive)[:n]),
+        byzantine=jnp.asarray(np.asarray(state.byzantine)[:n]),
+        edge_strikes=jnp.asarray(np.asarray(state.edge_strikes)),
+        key=jnp.asarray(np.asarray(state.key)),
+        round=jnp.asarray(np.asarray(state.round)),
+    )

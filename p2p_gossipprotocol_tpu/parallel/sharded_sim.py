@@ -1,0 +1,348 @@
+"""ShardedSimulator — the full simulation loop under ``shard_map``.
+
+Same round semantics as :class:`p2p_gossipprotocol_tpu.sim.Simulator`
+(churn → liveness/rewire → byzantine inject → gossip → metrics), with every
+per-peer and per-edge array sharded over the mesh's ``"peers"`` axis:
+
+  * the dissemination *gather* (``frontier[src]``) is shard-local because
+    each shard owns its peers' out-edges (partition.py);
+  * the dissemination *scatter* crosses shards as ONE ``psum_scatter`` of a
+    0/1 delivery buffer per round — the collective that replaces the
+    reference's per-message TCP sends (peer.cpp:310-312);
+  * anti-entropy pull reads a random neighbor's seen-set from an
+    ``all_gather`` — the analogue of the reference peers' full-state
+    exchange the BASELINE push-pull configs add.
+
+Randomness is drawn *globally* from the replicated key and sliced/gathered
+per shard, so every random decision (churn kills, rewire targets, fanout
+gates, pull contacts) is bitwise-invariant to the shard count.  That makes
+"1 device vs N devices give identical results" an exact, testable property
+(SURVEY.md §4, multi-chip tests) rather than a statistical one.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from p2p_gossipprotocol_tpu.graph import Topology
+from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+from p2p_gossipprotocol_tpu.models.byzantine import inject_byzantine
+from p2p_gossipprotocol_tpu.parallel.mesh import PEER_AXIS, make_mesh
+from p2p_gossipprotocol_tpu.parallel.partition import (
+    ShardedTopology,
+    partition_topology,
+    shard_state,
+    state_spec,
+)
+from p2p_gossipprotocol_tpu.sim import SimResult
+from p2p_gossipprotocol_tpu.state import GossipState, init_gossip_state
+
+AXIS = PEER_AXIS
+
+
+def _peer_uniform(key: jax.Array, n_pad: int, lo: jax.Array,
+                  block: int) -> jax.Array:
+    """Shard-count-invariant per-peer U(0,1): draw the full peer axis from
+    the replicated key, take this shard's slice.  O(n_pad) work per device
+    — a few MB even at 1M peers, negligible next to the scatter."""
+    u = jax.random.uniform(key, (n_pad,))
+    return jax.lax.dynamic_slice(u, (lo,), (block,))
+
+
+def _edge_uniform(key: jax.Array, e_gcap: int, gidx: jax.Array) -> jax.Array:
+    """Shard-count-invariant per-edge U(0,1): global draw, gathered through
+    each local slot's global edge index."""
+    return jax.random.uniform(key, (e_gcap,))[gidx]
+
+
+@dataclass
+class ShardedSimulator:
+    """Drop-in multi-chip counterpart of :class:`sim.Simulator`.
+
+    Construction partitions the (host-built) global topology over the mesh;
+    ``run``/``run_to_coverage`` execute the whole ``lax.scan`` /
+    ``lax.while_loop`` inside one ``shard_map`` so every collective lives
+    in the compiled loop body (nothing bounces through the host between
+    rounds).
+    """
+
+    topo: Topology
+    mesh: object = None          # jax.sharding.Mesh; default: all devices
+    n_msgs: int = 16
+    mode: str = "push"
+    fanout: int = 0
+    churn: ChurnConfig = field(default_factory=ChurnConfig)
+    byzantine_fraction: float = 0.0
+    n_honest_msgs: int | None = None
+    max_strikes: int = 3
+    rewire: bool = True
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.mesh is None:
+            self.mesh = make_mesh()
+        if self.mode not in ("push", "pull", "pushpull"):
+            raise ValueError(f"Unknown gossip mode: {self.mode}")
+        self.n_shards = int(np.prod(self.mesh.devices.shape))
+        self.stopo = partition_topology(self.topo, self.n_shards)
+        self._n_honest = (self.n_honest_msgs
+                          if self.n_honest_msgs is not None else self.n_msgs)
+        self._run_cache: dict = {}    # rounds -> jitted scan
+        self._loop_cache: dict = {}   # (target, max_rounds) -> compiled
+
+    # ------------------------------------------------------------------
+    def init_state(self, sources=None) -> GossipState:
+        """Init globally (bitwise-identical for any shard count), then lay
+        out on the mesh."""
+        key = jax.random.PRNGKey(self.seed)
+        global_state = init_gossip_state(
+            self.topo, self.n_msgs, key, sources=sources,
+            byzantine_fraction=self.byzantine_fraction,
+            n_honest_msgs=self._n_honest)
+        return shard_state(global_state, self.stopo, self.mesh)
+
+    # ------------------------------------------------------------------
+    # Local (per-shard) round pieces.  All arrays are this shard's block;
+    # src/dst/nbr indices are GLOBAL peer ids.
+    # ------------------------------------------------------------------
+    def _churn_local(self, key, alive, round_idx, valid_peer, topo, lo):
+        cfg = self.churn
+        if cfg.rate <= 0.0 and cfg.revive <= 0.0:
+            return alive
+        k_die, k_rev = jax.random.split(key)
+        u_die = _peer_uniform(k_die, topo.n_pad, lo, topo.block)
+        if cfg.kill_round >= 0:
+            dies = (round_idx == cfg.kill_round) & (u_die < cfg.rate)
+        else:
+            dies = u_die < cfg.rate
+        u_rev = _peer_uniform(k_rev, topo.n_pad, lo, topo.block)
+        revives = u_rev < cfg.revive
+        return ((alive & ~dies) | (~alive & revives)) & valid_peer
+
+    def _strike_local(self, key, topo: ShardedTopology, strikes, alive_g):
+        """Per-edge 3-strike liveness + rewiring, as in
+        liveness.strike_and_rewire but over this shard's edge block with
+        globally-drawn rewire targets."""
+        dst_dead = topo.edge_mask & ~alive_g[topo.dst]
+        strikes = jnp.where(dst_dead, strikes + 1, 0)
+        evict = strikes >= self.max_strikes
+        # First-crossing count only (see liveness.strike_and_rewire).
+        n_evict = jax.lax.psum(
+            jnp.sum(strikes == self.max_strikes, dtype=jnp.int32), AXIS)
+        if not self.rewire:
+            new_mask = topo.edge_mask & ~evict
+            return (topo.replace(edge_mask=new_mask),
+                    jnp.where(evict, 0, strikes), n_evict)
+        n = topo.n_peers
+        u = _edge_uniform(key, topo.e_gcap, topo.gidx)
+        offs = jnp.minimum((u * (n - 1)).astype(jnp.int32) + 1,
+                           max(n - 1, 1))
+        cand = (topo.src + offs) % n
+        take = evict & alive_g[cand]
+        new_dst = jnp.where(take, cand, topo.dst)
+        strikes = jnp.where(take, 0, strikes)
+        return topo.replace(dst=new_dst), strikes, n_evict
+
+    def _sample_neighbor_local(self, key, topo: ShardedTopology, lo):
+        """Each local peer samples one out-neighbor from its own edge rows
+        (pull gossip) — local CSR, global draw for shard invariance."""
+        u = _peer_uniform(key, topo.n_pad, lo, topo.block)
+        deg = topo.row_ptr[1:] - topo.row_ptr[:-1]
+        offs = (u * deg.astype(jnp.float32)).astype(jnp.int32)
+        offs = jnp.minimum(offs, jnp.maximum(deg - 1, 0))
+        idx = topo.row_ptr[:-1] + offs
+        idx = jnp.minimum(idx, topo.e_shard - 1)
+        nbr = topo.dst[idx]
+        valid = (deg > 0) & topo.edge_mask[idx]
+        return nbr, valid
+
+    def _gossip_local(self, key, state: GossipState, topo: ShardedTopology,
+                      alive_g, byz_g, lo):
+        """One dissemination round; returns (state', deliveries)."""
+        k_fan, k_nbr = jax.random.split(key)
+        m = state.n_msgs
+        partial = jnp.zeros((topo.n_pad, m), bool)
+        do_push = self.mode in ("push", "pushpull")
+        do_pull = self.mode in ("pull", "pushpull")
+
+        if do_push:
+            send = (state.frontier & state.alive[:, None]
+                    & ~state.byzantine[:, None])
+            gate = topo.edge_mask
+            if self.fanout > 0:
+                deg = (topo.row_ptr[1:] - topo.row_ptr[:-1]
+                       ).astype(jnp.float32)
+                rate = jnp.minimum(1.0, self.fanout / jnp.maximum(deg, 1.0))
+                u = _edge_uniform(k_fan, topo.e_gcap, topo.gidx)
+                gate = gate & (u < rate[topo.src - lo])
+            vals = send[topo.src - lo] & gate[:, None]
+            partial = partial.at[topo.dst].max(vals, mode="drop")
+
+        recv_pull = None
+        if do_pull:
+            seen_g = jax.lax.all_gather(state.seen, AXIS, tiled=True)
+            nbr, valid = self._sample_neighbor_local(k_nbr, topo, lo)
+            contact = valid & state.alive & alive_g[nbr]
+            recv_pull = seen_g[nbr] & (contact & ~byz_g[nbr])[:, None]
+            if self.mode == "pushpull":
+                give = state.seen & (contact & ~state.byzantine)[:, None]
+                partial = partial.at[nbr].max(give, mode="drop")
+
+        if do_push or self.mode == "pushpull":
+            counts = jax.lax.psum_scatter(partial.astype(jnp.int8), AXIS,
+                                          scatter_dimension=0, tiled=True)
+            recv = counts > 0
+        else:
+            recv = jnp.zeros_like(state.seen)
+        if recv_pull is not None:
+            recv = recv | recv_pull
+
+        recv = recv & state.alive[:, None]
+        new = recv & ~state.seen
+        deliveries = jax.lax.psum(jnp.sum(new, dtype=jnp.int32), AXIS)
+        state = state.replace(seen=state.seen | new, frontier=new,
+                              round=state.round + 1)
+        return state, deliveries
+
+    # ------------------------------------------------------------------
+    def _step_local(self, state: GossipState, topo: ShardedTopology):
+        """One full round on this shard's block.  Mirrors Simulator.step."""
+        sidx = jax.lax.axis_index(AXIS)
+        lo = sidx * topo.block
+        gid = lo + jnp.arange(topo.block)
+        valid_peer = gid < topo.n_peers
+
+        key, k_churn, k_rewire, k_round = jax.random.split(state.key, 4)
+        state = state.replace(key=key)
+
+        alive = self._churn_local(k_churn, state.alive, state.round,
+                                  valid_peer, topo, lo)
+        state = state.replace(alive=alive)
+        alive_g = jax.lax.all_gather(alive, AXIS, tiled=True)
+
+        topo, strikes, n_evict = self._strike_local(
+            k_rewire, topo, state.edge_strikes, alive_g)
+        state = state.replace(edge_strikes=strikes)
+
+        if self._n_honest < self.n_msgs:
+            state = inject_byzantine(state, self._n_honest)
+
+        byz_g = (jax.lax.all_gather(state.byzantine, AXIS, tiled=True)
+                 if self.mode in ("pull", "pushpull") else None)
+        state, deliveries = self._gossip_local(
+            k_round, state, topo, alive_g, byz_g, lo)
+
+        ok = state.alive & ~state.byzantine
+        denom = jnp.maximum(
+            jax.lax.psum(jnp.sum(ok, dtype=jnp.int32), AXIS), 1)
+        per_msg = jax.lax.psum(
+            jnp.sum(state.seen & ok[:, None], axis=0, dtype=jnp.int32),
+            AXIS) / denom
+        coverage = jnp.mean(per_msg[:self._n_honest])
+
+        metrics = {
+            "coverage": coverage,
+            "deliveries": deliveries,
+            "frontier_size": jax.lax.psum(
+                jnp.sum(state.frontier, dtype=jnp.int32), AXIS),
+            "live_peers": jax.lax.psum(
+                jnp.sum(state.alive, dtype=jnp.int32), AXIS),
+            "evictions": n_evict,
+        }
+        return state, topo, metrics
+
+    # ------------------------------------------------------------------
+    def _specs(self):
+        st_spec = state_spec()
+        tp_spec = self.stopo.spec()
+        from jax.sharding import PartitionSpec as P
+        metric_spec = {k: P() for k in ("coverage", "deliveries",
+                                        "frontier_size", "live_peers",
+                                        "evictions")}
+        return st_spec, tp_spec, metric_spec
+
+    def run(self, rounds: int, state: GossipState | None = None,
+            stopo: ShardedTopology | None = None) -> SimResult:
+        """Fixed-round scan with full metric history, all inside one
+        shard_map (collectives compiled into the loop body)."""
+        import time as _time
+
+        state = self.init_state() if state is None else state
+        stopo = self.stopo if stopo is None else stopo
+
+        if rounds not in self._run_cache:
+            st_spec, tp_spec, metric_spec = self._specs()
+
+            def scanned(st, tp):
+                def body(carry, _):
+                    st, tp = carry
+                    st, tp, metrics = self._step_local(st, tp)
+                    return (st, tp), metrics
+                return jax.lax.scan(body, (st, tp), None, length=rounds)
+
+            self._run_cache[rounds] = jax.jit(jax.shard_map(
+                scanned, mesh=self.mesh,
+                in_specs=(st_spec, tp_spec),
+                out_specs=((st_spec, tp_spec), metric_spec),
+                check_vma=False))
+        fn = self._run_cache[rounds]
+
+        t0 = _time.perf_counter()
+        (state, stopo), ys = fn(state, stopo)
+        jax.block_until_ready(state.seen)
+        wall = _time.perf_counter() - t0
+        return SimResult(
+            state=state, topo=stopo,
+            coverage=np.asarray(ys["coverage"]),
+            deliveries=np.asarray(ys["deliveries"]),
+            frontier_size=np.asarray(ys["frontier_size"]),
+            live_peers=np.asarray(ys["live_peers"]),
+            evictions=np.asarray(ys["evictions"]),
+            wall_s=wall,
+        )
+
+    def run_to_coverage(self, target: float = 0.99, max_rounds: int = 256,
+                        state: GossipState | None = None):
+        """while_loop until coverage ≥ target (the benchmark path).
+        Returns (state, stopo, rounds_run, wall_seconds); compile time is
+        excluded from the timed run."""
+        import time as _time
+
+        state = self.init_state() if state is None else state
+        stopo = self.stopo
+
+        cache_key = (target, max_rounds)
+        if cache_key not in self._loop_cache:
+            st_spec, tp_spec, _ = self._specs()
+            from jax.sharding import PartitionSpec as P
+
+            def looped(st, tp):
+                def cond(carry):
+                    st, tp, cov = carry
+                    return (cov < target) & (st.round < max_rounds)
+
+                def body(carry):
+                    st, tp, _ = carry
+                    st, tp, metrics = self._step_local(st, tp)
+                    return st, tp, metrics["coverage"]
+
+                return jax.lax.while_loop(cond, body,
+                                          (st, tp, jnp.float32(0)))
+
+            fn = jax.jit(jax.shard_map(
+                looped, mesh=self.mesh,
+                in_specs=(st_spec, tp_spec),
+                out_specs=(st_spec, tp_spec, P()),
+                check_vma=False))
+            self._loop_cache[cache_key] = fn.lower(state, stopo).compile()
+        fn_c = self._loop_cache[cache_key]
+        t0 = _time.perf_counter()
+        st, tp, cov = fn_c(state, stopo)
+        jax.block_until_ready(st.seen)
+        wall = _time.perf_counter() - t0
+        return st, tp, int(st.round), wall

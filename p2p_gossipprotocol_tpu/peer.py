@@ -65,6 +65,13 @@ class PeerNode:
         self._threads: list[threading.Thread] = []
         self.log = NodeLogger("peer", port, log_dir)
 
+    def _track(self, t: threading.Thread) -> None:
+        """Track a daemon thread, pruning finished ones so long-running
+        socket mode (one handler thread per accepted probe/connection)
+        doesn't accumulate dead Thread objects without bound."""
+        self._threads = [x for x in self._threads if x.is_alive()]
+        self._threads.append(t)
+
     # -- lifecycle -----------------------------------------------------
     def start(self, wait_for_quorum: bool = True,
               bootstrap_timeout: float = 30.0) -> bool:
@@ -173,7 +180,7 @@ class PeerNode:
             t = threading.Thread(target=self._handle_client,
                                  args=(sock, key), daemon=True)
             t.start()
-            self._threads.append(t)
+            self._track(t)
             self.log.log(f"Connected to peer: {peer.ip}:{peer.port}")
 
     # -- serving (peer.cpp:87-101, 255-295) ----------------------------
@@ -185,7 +192,7 @@ class PeerNode:
             t = threading.Thread(target=self._handle_client, args=(conn,),
                                  daemon=True)
             t.start()
-            self._threads.append(t)
+            self._track(t)
 
     def _handle_client(self, conn, peer_key=None) -> None:
         stream = JsonStream(conn)

@@ -81,6 +81,8 @@ class SeedNode:
             t = threading.Thread(target=self._handle_client, args=(conn,),
                                  daemon=True)
             t.start()
+            # prune finished handlers so the list stays bounded
+            self._threads = [x for x in self._threads if x.is_alive()]
             self._threads.append(t)
 
     def _handle_client(self, conn) -> None:

@@ -12,6 +12,7 @@ that arrive coalesced are split correctly instead of crashing the parser.
 
 from __future__ import annotations
 
+import codecs
 import json
 import socket
 
@@ -33,6 +34,11 @@ class JsonStream:
     def __init__(self, sock: socket.socket):
         self.sock = sock
         self._buf = ""
+        # Incremental decoder: a multibyte UTF-8 character split across two
+        # recv()s is held until its continuation bytes arrive, instead of
+        # being mangled to U+FFFD by a per-chunk decode.
+        self._decoder = codecs.getincrementaldecoder("utf-8")(
+            errors="replace")
 
     def recv_objects(self) -> list[dict] | None:
         """Block for one recv; return parsed docs (possibly several, or
@@ -43,7 +49,7 @@ class JsonStream:
             return None
         if not chunk:
             return None
-        self._buf += chunk.decode(errors="replace")
+        self._buf += self._decoder.decode(chunk)
         out = []
         while True:
             s = self._buf.lstrip()

@@ -17,6 +17,12 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import jax  # noqa: E402  (import after env setup)
 
+# A site hook may have already imported jax and pinned an accelerator
+# platform (e.g. a tunneled single TPU chip).  Backend init is lazy, so
+# forcing the platform here — before any jax.devices() call — still wins,
+# and the XLA flag above gives us the 8-device virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,102 @@
+"""Multi-chip sharding tests (SURVEY.md §4, multi-chip bullet): the same
+simulation on 1 device vs 8 virtual devices must be bitwise-identical
+given the same PRNG seed — an exact property, not a statistical one,
+because all randomness is drawn globally and sliced per shard
+(parallel/sharded_sim.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from p2p_gossipprotocol_tpu import graph
+from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+from p2p_gossipprotocol_tpu.parallel import (ShardedSimulator, make_mesh,
+                                             partition_topology,
+                                             unshard_state)
+from p2p_gossipprotocol_tpu.sim import Simulator
+
+
+@pytest.fixture(scope="module")
+def topo():
+    return graph.erdos_renyi(7, 192, avg_degree=8)
+
+
+def test_partition_roundtrip(topo):
+    """Partitioning preserves every live edge exactly."""
+    st = partition_topology(topo, 8)
+    g_src = np.asarray(topo.src)[np.asarray(topo.edge_mask)]
+    g_dst = np.asarray(topo.dst)[np.asarray(topo.edge_mask)]
+    s_mask = np.asarray(st.edge_mask)
+    s_src = np.asarray(st.src)[s_mask]
+    s_dst = np.asarray(st.dst)[s_mask]
+    ref = set(zip(g_src.tolist(), g_dst.tolist()))
+    got = set(zip(s_src.tolist(), s_dst.tolist()))
+    assert ref == got
+    # Per-shard CSR covers exactly the shard's peers' rows.
+    assert st.n_pad % 8 == 0
+    assert st.row_ptr.shape[0] == 8 * (st.block + 1)
+
+
+def test_push_flood_matches_unsharded(topo, devices8):
+    """Push flood with no churn has no RNG in the round — sharded runs on
+    1 and 8 devices must match the unsharded Simulator exactly."""
+    ref = Simulator(topo=topo, n_msgs=8, mode="push", seed=3).run(12)
+    for n_dev in (1, 8):
+        sim = ShardedSimulator(topo=topo, mesh=make_mesh(n_dev),
+                               n_msgs=8, mode="push", seed=3)
+        res = sim.run(12)
+        # seen/deliveries are exact; coverage is a float reduction whose
+        # order differs between the sharded and unsharded programs (psum
+        # vs single-device sum) — allow 1-ulp wiggle there only.
+        np.testing.assert_allclose(res.coverage, ref.coverage, rtol=1e-6)
+        np.testing.assert_array_equal(res.deliveries, ref.deliveries)
+        got = unshard_state(res.state, sim.stopo)
+        np.testing.assert_array_equal(np.asarray(got.seen),
+                                      np.asarray(ref.state.seen))
+
+
+def test_shard_count_invariance_full_stack(topo, devices8):
+    """Everything on: push-pull + fanout + continuous churn + byzantine
+    injection + rewiring.  1-device and 8-device runs must agree bitwise."""
+    def make(n_dev):
+        return ShardedSimulator(
+            topo=topo, mesh=make_mesh(n_dev), n_msgs=12, mode="pushpull",
+            fanout=3, churn=ChurnConfig(rate=0.02, revive=0.01),
+            byzantine_fraction=0.1, n_honest_msgs=8, max_strikes=2,
+            seed=11)
+
+    res1 = make(1).run(20)
+    res8 = make(8).run(20)
+    np.testing.assert_allclose(res1.coverage, res8.coverage, rtol=1e-6)
+    np.testing.assert_array_equal(res1.deliveries, res8.deliveries)
+    np.testing.assert_array_equal(res1.live_peers, res8.live_peers)
+    np.testing.assert_array_equal(res1.evictions, res8.evictions)
+    s1 = unshard_state(res1.state, make(1).stopo)
+    s8 = unshard_state(res8.state, make(8).stopo)
+    np.testing.assert_array_equal(np.asarray(s1.seen), np.asarray(s8.seen))
+    np.testing.assert_array_equal(np.asarray(s1.alive), np.asarray(s8.alive))
+
+
+def test_sharded_coverage_reaches_target(topo, devices8):
+    sim = ShardedSimulator(topo=topo, mesh=make_mesh(8), n_msgs=4,
+                           mode="pushpull", seed=5)
+    st, tp, rounds, wall = sim.run_to_coverage(target=0.99, max_rounds=64)
+    assert 0 < rounds < 64
+    assert wall > 0
+
+
+def test_sharded_pull_mode_runs(topo, devices8):
+    sim = ShardedSimulator(topo=topo, mesh=make_mesh(8), n_msgs=4,
+                           mode="pull", seed=5)
+    res = sim.run(40)
+    assert res.coverage[-1] > 0.9
+
+
+def test_sharded_state_has_expected_layout(topo, devices8):
+    mesh = make_mesh(8)
+    sim = ShardedSimulator(topo=topo, mesh=mesh, n_msgs=4, seed=0)
+    st = sim.init_state()
+    assert st.seen.shape == (sim.stopo.n_pad, 4)
+    shard_shapes = {s.data.shape for s in st.seen.addressable_shards}
+    assert shard_shapes == {(sim.stopo.block, 4)}
