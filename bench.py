@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Benchmark harness — prints ONE JSON line.
+
+Headline metric (BASELINE.md north star): wall-clock seconds for 1M-peer
+push-pull gossip (power-law degree law, uniform random targets) to reach
+99% message coverage.  Baseline target is 2.0 s on TPU v5e-8;
+``vs_baseline = 2.0 / measured`` (>1 beats the target).
+
+Engine: the hardware-aligned pallas engine (aligned.py) — bit-packed
+message words, lane-wise dynamic-gather dissemination — which is the
+framework's scale path.  ``GOSSIP_BENCH_ENGINE=edges`` switches to the
+exact edge-list engine (sim.py) for comparison.
+
+Timing discipline: compilation and the remote backend's one-time
+program-upload are excluded (warm-up execution); completion is forced via
+a scalar device transfer, not block_until_ready (broken for AOT
+executables on some PJRT backends).  Graph construction is reported in
+the line but not counted — the reference's analogue (TCP bootstrap) is
+outside its dissemination path too.
+
+Env knobs: GOSSIP_BENCH_PEERS (default 1_048_576), GOSSIP_BENCH_MSGS (16),
+GOSSIP_BENCH_DEGREE (16), GOSSIP_BENCH_MODE (pushpull),
+GOSSIP_BENCH_ENGINE (aligned | edges).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_S = 2.0  # 1M peers to 99% coverage, BASELINE.md north star
+
+
+def _bench_aligned(n, n_msgs, degree, mode):
+    import jax
+    import numpy as np
+
+    from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator,
+                                                _popcount_sum,
+                                                build_aligned)
+
+    t0 = time.perf_counter()
+    topo = build_aligned(seed=0, n=n, n_slots=degree,
+                         degree_law="powerlaw")
+    graph_s = time.perf_counter() - t0
+    sim = AlignedSimulator(topo=topo, n_msgs=n_msgs, mode=mode, seed=0)
+    state, _topo, rounds, wall = sim.run_to_coverage(target=0.99,
+                                                     max_rounds=128)
+    total_seen = int(jax.device_get(_popcount_sum(state.seen_w)))
+    n_edges = int(np.asarray(topo.deg).sum())
+    return rounds, wall, total_seen, n_edges, graph_s
+
+
+def _bench_edges(n, n_msgs, degree, mode):
+    import jax
+
+    from p2p_gossipprotocol_tpu import graph
+    from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+    from p2p_gossipprotocol_tpu.sim import Simulator
+
+    t0 = time.perf_counter()
+    topo = graph.reference_powerlaw(seed=0, n=n, max_degree=degree)
+    graph_s = time.perf_counter() - t0
+    sim = Simulator(topo=topo, n_msgs=n_msgs, mode=mode,
+                    churn=ChurnConfig(rate=0.05, kill_round=1),
+                    max_strikes=3, rewire=True, seed=0)
+    state, _t, rounds, wall = sim.run_to_coverage(target=0.99,
+                                                  max_rounds=128)
+    total_seen = int(jax.device_get(state.seen.sum()))
+    import numpy as np
+    n_edges = int(np.asarray(topo.edge_mask).sum())
+    return rounds, wall, total_seen, n_edges, graph_s
+
+
+def main() -> int:
+    n = int(os.environ.get("GOSSIP_BENCH_PEERS", str(1 << 20)))
+    n_msgs = int(os.environ.get("GOSSIP_BENCH_MSGS", "16"))
+    degree = int(os.environ.get("GOSSIP_BENCH_DEGREE", "16"))
+    mode = os.environ.get("GOSSIP_BENCH_MODE", "pushpull")
+    engine = os.environ.get("GOSSIP_BENCH_ENGINE", "aligned")
+
+    import jax
+
+    if engine == "aligned":
+        fn = _bench_aligned
+    elif engine == "edges":
+        fn = _bench_edges
+    else:
+        raise SystemExit(f"unknown GOSSIP_BENCH_ENGINE: {engine!r} "
+                         "(expected 'aligned' or 'edges')")
+    rounds, wall, total_seen, n_edges, graph_s = fn(n, n_msgs, degree, mode)
+
+    deliveries = max(total_seen - n_msgs, 0)
+    msgs_per_sec = deliveries / wall if wall > 0 else 0.0
+    device = str(jax.devices()[0]).replace(" ", "_")
+    n_label = "1M" if n == 1 << 20 else str(n)
+    print(json.dumps({
+        "metric": f"time_to_99pct_coverage_{n_label}_{mode}",
+        "value": round(wall, 4),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_S / wall, 3) if wall > 0 else 0.0,
+        "n_peers": n,
+        "n_msgs": n_msgs,
+        "mode": mode,
+        "engine": engine,
+        "rounds": rounds,
+        "deliveries": deliveries,
+        "msgs_per_sec_per_chip": round(msgs_per_sec, 1),
+        "graph_build_s": round(graph_s, 2),
+        "n_edges": n_edges,
+        "device": device,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

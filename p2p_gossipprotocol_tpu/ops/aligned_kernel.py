@@ -1,0 +1,131 @@
+"""Pallas TPU kernel for the hardware-aligned gossip pass.
+
+Why this exists: the exact-graph engines (ops/propagate.py) express
+dissemination as an edge-list gather/scatter, which XLA lowers to one DMA
+descriptor per element on TPU — measured ~110M lookups/s (~0.4 GB/s
+useful), hundreds of ms per round at 1M peers.  The TPU's fast paths are
+streaming loads, lane-wise `tpu.dynamic_gather` (take_along_axis over the
+128-lane axis), and block-level DMA re-indexing — so the aligned overlay
+(aligned.py) is *factored into exactly those primitives*:
+
+    neighbor_d(r, c) = ( perm[ roll_d(r) ],  colidx_d[r, c] )
+
+* ``perm``    — one static random row permutation (applied OUTSIDE the
+  kernel as a 512-byte-row XLA gather: row gathers are per-row bound,
+  8192 rows ≈ 0.2 ms — cheap at this granularity);
+* ``roll_d``  — per-slot block roll, applied FOR FREE via the BlockSpec
+  index map (the DMA just reads a different block);
+* ``colidx``  — per-peer random lane choice, the in-kernel
+  ``take_along_axis(..., axis=1)`` that Mosaic lowers to one
+  ``tpu.dynamic_gather`` per 8x128 vreg.
+
+Messages are bit-packed: 32 rumors per int32 word, so one [R, 128] int32
+array is the whole network's seen/frontier state and OR is the dedup.
+
+The kernel runs a (T row-blocks x D slots) grid, accumulating the slot OR
+into the output block, which stays resident in VMEM across the inner d
+loop (d is the innermost grid dim).  Per-slot gating:
+
+* push pass: slot d live iff ``d < gate`` (gate = per-peer in-degree —
+  the power-law degree law, reference peer.cpp:219-222);
+* pull pass: slot d live iff ``d == gate`` (gate = this round's sampled
+  contact slot — classic one-neighbor anti-entropy).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+
+
+def _pass_kernel(pull: bool, rolls_ref, subrolls_ref, y_ref, col_ref,
+                 gate_ref, acc_ref):
+    d = pl.program_id(1)
+    # Per-slot sublane roll: out-row i reads y-row (i + s_d) % blk, so a
+    # peer's D slots see D distinct source rows even when the grid has a
+    # single row block (otherwise all slots would read perm[r] and rumors
+    # would be trapped inside that one permutation's cycles).
+    # pltpu.roll(x, s) moves row i to i+s, i.e. out-row i sees row i-s —
+    # so rolling by -s_d would READ row i+s_d; jnp.roll has the same
+    # convention but its dynamic-shift form doesn't lower on Mosaic.
+    blk = y_ref.shape[0]
+    y = pltpu.roll(y_ref[:], blk - subrolls_ref[d], axis=0)
+    col = col_ref[0].astype(jnp.int32)
+    z = jnp.take_along_axis(y, col, axis=1)
+    g = gate_ref[:].astype(jnp.int32)
+    mask = (g == d) if pull else (d < g)
+    z = jnp.where(mask, z, 0)
+
+    @pl.when(d == 0)
+    def _():
+        acc_ref[:] = z
+
+    @pl.when(d > 0)
+    def _():
+        acc_ref[:] = acc_ref[:] | z
+
+
+def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
+                rolls: jax.Array, subrolls: jax.Array, *,
+                pull: bool = False, rowblk: int = 512,
+                interpret: bool = False) -> jax.Array:
+    """One OR-accumulated D-slot pass.
+
+    ``y``       int32[R, 128]  — row-permuted packed sender words
+    ``colidx``  int8 [D, R, 128] — per-slot lane choices
+    ``gate``    int8 [R, 128]  — degree (push) / sampled slot (pull)
+    ``rolls``   int32[D]       — per-slot block-roll offsets (scalar
+                                 prefetch; drives the y index map)
+    ``subrolls`` int32[D]      — per-slot sublane roll within the block
+    Returns int32[R, 128]: words each peer hears this pass.
+    """
+    R, C = y.shape
+    assert C == LANES, f"lane dim must be {LANES}, got {C}"
+    D = colidx.shape[0]
+    blk = min(rowblk, R)
+    assert R % blk == 0
+    T = R // blk
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(T, D),
+        in_specs=[
+            pl.BlockSpec((blk, C), lambda t, d, k, s: ((t + k[d]) % T, 0)),
+            pl.BlockSpec((1, blk, C), lambda t, d, k, s: (d, t, 0)),
+            pl.BlockSpec((blk, C), lambda t, d, k, s: (t, 0)),
+        ],
+        out_specs=pl.BlockSpec((blk, C), lambda t, d, k, s: (t, 0)),
+    )
+    return pl.pallas_call(
+        functools.partial(_pass_kernel, pull),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((R, C), jnp.int32),
+        interpret=interpret,
+    )(rolls, subrolls, y, colidx, gate)
+
+
+def neighbor_ids(perm, rolls, subrolls, colidx, *, rowblk: int = 512):
+    """Reference (host/XLA) computation of the composite neighbor map —
+    the ground truth the kernel is tested against, and the bridge that
+    lets the exact-graph engines consume an aligned overlay as an edge
+    list.  Returns int32[D, R, 128]: flat peer id of slot d's neighbor
+    for peer (r, c)."""
+    R = perm.shape[0]
+    D = colidx.shape[0]
+    blk = min(rowblk, R)
+    T = R // blk
+    r = jnp.arange(R, dtype=jnp.int32)
+    out = []
+    for d in range(D):
+        src_row = (((r // blk + rolls[d]) % T) * blk
+                   + (r % blk + subrolls[d]) % blk)
+        nbr_row = perm[src_row]                       # [R]
+        nbr_col = colidx[d].astype(jnp.int32)         # [R, 128]
+        out.append(nbr_row[:, None] * LANES + nbr_col)
+    return jnp.stack(out)
