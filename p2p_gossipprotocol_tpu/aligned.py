@@ -159,9 +159,14 @@ class AlignedSimulator:
         key = jax.random.PRNGKey(self.seed)
         src = (jnp.arange(self.n_msgs, dtype=jnp.int32)
                * max(n // self.n_msgs, 1)) % n
-        bits = jnp.zeros(rows * LANES, jnp.int32).at[src].max(
-            jnp.int32(1) << jnp.arange(self.n_msgs, dtype=jnp.int32))
-        seen = bits.reshape(rows, LANES)
+        # Seed words in uint32 with scatter-ADD: distinct message bits add
+        # like OR (so colliding sources keep every rumor), and bit 31
+        # survives (an int32 `1 << 31` would wrap negative and be dropped
+        # by a max-combiner).  Bitcast back to the engine's int32 words.
+        bits_u = jnp.zeros(rows * LANES, jnp.uint32).at[src].add(
+            jnp.uint32(1) << jnp.arange(self.n_msgs, dtype=jnp.uint32))
+        seen = jax.lax.bitcast_convert_type(
+            bits_u, jnp.int32).reshape(rows, LANES)
         return AlignedState(seen_w=seen, frontier_w=seen, key=key,
                             round=jnp.int32(0))
 

@@ -45,24 +45,37 @@ def build_parser() -> argparse.ArgumentParser:
                    help="jax mode: rounds to simulate")
     p.add_argument("--mode", choices=["push", "pull", "pushpull"],
                    default=None, help="gossip mode override")
+    p.add_argument("--engine", choices=["edges", "aligned"],
+                   default="edges",
+                   help="jax mode: exact edge-list engine, or the "
+                        "hardware-aligned pallas engine (1M+ peers)")
     p.add_argument("--target-coverage", type=float, default=0.99)
     p.add_argument("--local-ip", default=None)
     p.add_argument("--local-port", type=int, default=None)
+    p.add_argument("--metrics-jsonl", default=None, metavar="PATH",
+                   help="write per-round metrics as JSONL")
+    p.add_argument("--profile-dir", default=None, metavar="DIR",
+                   help="jax.profiler trace directory for the run")
     p.add_argument("--quiet", action="store_true")
     return p
 
 
 def _run_jax(cfg: NetworkConfig, args) -> int:
-    from p2p_gossipprotocol_tpu.sim import Simulator
+    from p2p_gossipprotocol_tpu.utils import metrics as metrics_lib
 
-    sim = Simulator.from_config(cfg, n_peers=args.n_peers)
     rounds = args.rounds or cfg.rounds or 64
-    if not args.quiet:
-        print(f"[jax] simulating {sim.topo.n_peers} peers, "
-              f"{sim.n_msgs} messages, mode={sim.mode}, "
-              f"{int(sim.topo.n_edges())} edges")
-    res = sim.run(rounds)
-    r99 = res.rounds_to(args.target_coverage)
+    with metrics_lib.profile(args.profile_dir):
+        if args.engine == "aligned":
+            return _run_jax_aligned(cfg, args, rounds, metrics_lib)
+
+        from p2p_gossipprotocol_tpu.sim import Simulator
+
+        sim = Simulator.from_config(cfg, n_peers=args.n_peers)
+        if not args.quiet:
+            print(f"[jax] simulating {sim.topo.n_peers} peers, "
+                  f"{sim.n_msgs} messages, mode={sim.mode}, "
+                  f"{int(sim.topo.n_edges())} edges")
+        res = sim.run(rounds)
     if not args.quiet:
         for i in range(len(res.coverage)):
             print(f"round {i + 1:4d}  coverage={res.coverage[i]:.4f}  "
@@ -71,15 +84,63 @@ def _run_jax(cfg: NetworkConfig, args) -> int:
                   f"evictions={res.evictions[i]:6d}")
             if res.coverage[i] >= 0.999999 and res.frontier_size[i] == 0:
                 break
+    if args.metrics_jsonl:
+        with open(args.metrics_jsonl, "w") as fp:
+            metrics_lib.emit_jsonl(metrics_lib.rows_from_result(res), fp,
+                                   n_peers=sim.topo.n_peers,
+                                   mode=sim.mode, engine="edges")
     print(json.dumps({
         "n_peers": sim.topo.n_peers,
         "n_msgs": sim.n_msgs,
         "mode": sim.mode,
+        "engine": "edges",
         "rounds_run": rounds,
-        "final_coverage": float(res.coverage[-1]),
-        f"rounds_to_{args.target_coverage:g}": r99,
-        "total_deliveries": res.total_deliveries,
-        "wall_s": round(res.wall_s, 4),
+        **metrics_lib.summarize(res, args.target_coverage),
+    }))
+    return 0
+
+
+def _run_jax_aligned(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
+    from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator,
+                                                build_aligned)
+
+    n = args.n_peers or cfg.n_peers or len(cfg.seed_nodes)
+    if cfg.mode not in ("push", "pushpull"):
+        print(f"Error: --engine aligned supports push/pushpull, "
+              f"not {cfg.mode!r} (use --engine edges for pull)",
+              file=sys.stderr)
+        return 1
+    mode = cfg.mode
+    law = "powerlaw" if cfg.graph in ("reference", "powerlaw") else "regular"
+    topo = build_aligned(seed=cfg.prng_seed, n=n,
+                         n_slots=min(cfg.avg_degree or 16, 127),
+                         degree_law=law, powerlaw_alpha=cfg.powerlaw_alpha)
+    n_msgs = min(cfg.n_messages or cfg.max_message_count, 32)
+    sim = AlignedSimulator(topo=topo, n_msgs=n_msgs, mode=mode,
+                           seed=cfg.prng_seed)
+    if not args.quiet:
+        print(f"[jax/aligned] simulating {n} peers, {n_msgs} messages, "
+              f"mode={mode}, {sim.topo.n_slots} slots/peer")
+    state, ys, wall = sim.run(rounds)
+    cov = ys["coverage"]
+    if args.metrics_jsonl:
+        rows = [{k: v[i] for k, v in ys.items()}
+                for i in range(len(cov))]
+        with open(args.metrics_jsonl, "w") as fp:
+            metrics_lib.emit_jsonl(rows, fp, n_peers=n, mode=mode,
+                                   engine="aligned")
+    hit = (cov >= args.target_coverage).nonzero()[0]
+    print(json.dumps({
+        "n_peers": n,
+        "n_msgs": n_msgs,
+        "mode": mode,
+        "engine": "aligned",
+        "rounds_run": rounds,
+        "final_coverage": float(cov[-1]),
+        f"rounds_to_{args.target_coverage:g}":
+            int(hit[0]) + 1 if hit.size else -1,
+        "total_deliveries": int(ys["deliveries"].sum()),
+        "wall_s": round(wall, 4),
     }))
     return 0
 

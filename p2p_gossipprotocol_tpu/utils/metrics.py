@@ -1,0 +1,90 @@
+"""Structured per-round metrics + profiling hooks.
+
+The reference's entire observability story is an append-only text log
+with ctime prefixes (logToFile, peer.cpp:125-133 / seed.cpp:180-188) and
+stderr.  Here every round of a run yields a structured record (coverage,
+deliveries, frontier size, live peers, evictions) and this module emits
+them as JSONL — machine-readable, one object per round — plus derived
+summary numbers (rounds-to-target, msgs/sec) and an optional
+``jax.profiler`` trace context around the hot loop.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import IO, Iterable, Mapping
+
+
+def emit_jsonl(rows: Iterable[Mapping], fp: IO[str], **common) -> int:
+    """Write one JSON object per round.  ``common`` fields (run id, config
+    name, peer count, ...) are merged into every row.  Returns the number
+    of rows written."""
+    n = 0
+    for i, row in enumerate(rows):
+        rec = {"round": i + 1, **common}
+        for k, v in row.items():
+            rec[k] = v.item() if hasattr(v, "item") else v
+        fp.write(json.dumps(rec) + "\n")
+        n += 1
+    return n
+
+
+def rows_from_result(res) -> list[dict]:
+    """Per-round rows from a sim.SimResult (or anything exposing the same
+    metric arrays)."""
+    out = []
+    for i in range(len(res.coverage)):
+        out.append({
+            "coverage": float(res.coverage[i]),
+            "deliveries": int(res.deliveries[i]),
+            "frontier_size": int(res.frontier_size[i]),
+            "live_peers": int(res.live_peers[i]),
+            "evictions": int(res.evictions[i]),
+        })
+    return out
+
+
+def summarize(res, target: float = 0.99) -> dict:
+    """Run-level summary: the BASELINE.md metrics."""
+    return {
+        "rounds": int(len(res.coverage)),
+        "final_coverage": float(res.coverage[-1]),
+        f"rounds_to_{target:g}": int(res.rounds_to(target)),
+        "total_deliveries": int(res.deliveries.sum()),
+        "wall_s": float(res.wall_s),
+        "msgs_per_sec": (float(res.deliveries.sum() / res.wall_s)
+                         if res.wall_s else 0.0),
+    }
+
+
+@contextlib.contextmanager
+def profile(log_dir: str | None):
+    """``jax.profiler`` trace around the enclosed block; no-op when
+    ``log_dir`` is None (so callers can thread a CLI flag straight in)."""
+    if log_dir is None:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class RoundLogger:
+    """Streaming logger for host-driven loops (socket mode, interactive
+    stepping): mirrors the reference's logToFile event kinds but as
+    structured records."""
+
+    def __init__(self, fp: IO[str], **common):
+        self.fp = fp
+        self.common = common
+
+    def log(self, event: str, **fields) -> None:
+        rec = {"ts": time.time(), "event": event, **self.common, **fields}
+        self.fp.write(json.dumps(rec) + "\n")
+        self.fp.flush()

@@ -116,6 +116,19 @@ def test_pushpull_converges_and_deterministic():
     assert ma["coverage"][-1] > 0.99
 
 
+def test_full_32_message_pack_floods():
+    """Bit 31 (the int32 sign bit) must seed and propagate like any other
+    message — regression for the scatter-max seeding that dropped it."""
+    topo = build_aligned(seed=6, n=1024, n_slots=6)
+    sim = AlignedSimulator(topo=topo, n_msgs=32, mode="push", seed=0)
+    st = sim.init_state()
+    seeded = np.asarray(st.seen_w).view(np.uint32)
+    popc = np.unpackbits(seeded.view(np.uint8)).sum()
+    assert popc == 32  # every message seeded exactly once
+    _, metrics, _ = sim.run(14)
+    assert metrics["coverage"][-1] == pytest.approx(1.0)
+
+
 def test_powerlaw_degree_law():
     topo = build_aligned(seed=3, n=4096, n_slots=12,
                         degree_law="powerlaw", powerlaw_alpha=2.5)

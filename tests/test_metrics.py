@@ -1,0 +1,68 @@
+"""Observability: JSONL metric emission, summaries, CLI integration."""
+
+import io
+import json
+import subprocess
+import sys
+
+from p2p_gossipprotocol_tpu import graph
+from p2p_gossipprotocol_tpu.sim import Simulator
+from p2p_gossipprotocol_tpu.utils import metrics
+
+
+def test_emit_jsonl_and_summary():
+    topo = graph.erdos_renyi(1, 128, avg_degree=6)
+    sim = Simulator(topo=topo, n_msgs=4, mode="push", seed=0)
+    res = sim.run(8)
+
+    buf = io.StringIO()
+    n = metrics.emit_jsonl(metrics.rows_from_result(res), buf,
+                           n_peers=128, engine="edges")
+    assert n == 8
+    lines = [json.loads(line) for line in buf.getvalue().splitlines()]
+    assert len(lines) == 8
+    assert lines[0]["round"] == 1
+    assert lines[0]["n_peers"] == 128
+    assert 0.0 <= lines[-1]["coverage"] <= 1.0
+    assert all(isinstance(r["deliveries"], int) for r in lines)
+
+    s = metrics.summarize(res, 0.99)
+    assert s["rounds"] == 8
+    assert s["rounds_to_0.99"] == res.rounds_to(0.99)
+    assert s["total_deliveries"] == int(res.deliveries.sum())
+
+
+def test_cli_metrics_jsonl(tmp_path):
+    out = tmp_path / "metrics.jsonl"
+    proc = subprocess.run(
+        [sys.executable, "-m", "p2p_gossipprotocol_tpu.cli",
+         "/root/reference/network.txt", "--backend", "jax",
+         "--n-peers", "200", "--rounds", "6", "--quiet",
+         "--metrics-jsonl", str(out)],
+        capture_output=True, text=True, timeout=300,
+        env={"PYTHONPATH": "/root/repo", "JAX_PLATFORMS": "cpu",
+             "PATH": "/usr/bin:/bin:/usr/local/bin"},
+        cwd="/root/repo")
+    assert proc.returncode == 0, proc.stderr
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["n_peers"] == 200
+    assert result["rounds_run"] == 6
+    rows = [json.loads(line) for line in out.read_text().splitlines()]
+    assert len(rows) == 6
+    assert rows[-1]["coverage"] == result["final_coverage"]
+
+
+def test_cli_aligned_engine(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "-m", "p2p_gossipprotocol_tpu.cli",
+         "/root/reference/network.txt", "--backend", "jax",
+         "--engine", "aligned", "--n-peers", "1024", "--rounds", "10",
+         "--quiet"],
+        capture_output=True, text=True, timeout=300,
+        env={"PYTHONPATH": "/root/repo", "JAX_PLATFORMS": "cpu",
+             "PATH": "/usr/bin:/bin:/usr/local/bin"},
+        cwd="/root/repo")
+    assert proc.returncode == 0, proc.stderr
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["engine"] == "aligned"
+    assert result["final_coverage"] > 0.99
