@@ -1,0 +1,257 @@
+// Native host-side runtime for p2p_gossipprotocol_tpu.
+//
+// The reference implementation is C++17 end to end (SURVEY.md §2: g++
+// -std=c++17, OpenSSL libcrypto for SHA-256, BSD sockets).  The TPU
+// rebuild keeps the COMPUTE path in JAX/Pallas, but the host runtime
+// pieces that the reference implements natively stay native here:
+//
+//  * SHA-256 message identity (reference calculateMessageHash,
+//    peer.cpp:135-159) — own compact implementation, no OpenSSL
+//    dependency, exposed to Python through ctypes (info.py uses it when
+//    the library is built, hashlib otherwise — both produce standard
+//    SHA-256 so identities agree).
+//  * Overlay construction at 10M+ peers (reference
+//    selectAndConnectPeers, peer.cpp:214-253): edge-list generators for
+//    the power-law / Erdős–Rényi / Barabási–Albert families.  The
+//    numpy builders in graph.py take ~30 s at 1M peers; these run the
+//    same laws in a tight loop with a SplitMix64/xoshiro generator.
+//  * Length-framed message codec for the socket transport (the framing
+//    the reference lacks — unframed 4 KB reads, peer.cpp:188-194 —
+//    which breaks under TCP fragmentation; SURVEY.md §2-C7).
+//
+// Build: `make -C native` (plus a `tsan` target; the reference ships
+// no sanitizer config despite real data races — SURVEY.md §5).
+//
+// C ABI only — consumed via ctypes, no pybind11.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// SHA-256 (FIPS 180-4), compact single-shot implementation.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+constexpr uint32_t kK[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+inline uint32_t rotr(uint32_t x, int s) { return (x >> s) | (x << (32 - s)); }
+
+void sha256_block(uint32_t h[8], const uint8_t* p) {
+  uint32_t w[64];
+  for (int i = 0; i < 16; i++)
+    w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+           (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+  for (int i = 16; i < 64; i++) {
+    uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = h[0], b = h[1], c = h[2], d = h[3];
+  uint32_t e = h[4], f = h[5], g = h[6], hh = h[7];
+  for (int i = 0; i < 64; i++) {
+    uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = hh + S1 + ch + kK[i] + w[i];
+    uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+    uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t t2 = S0 + maj;
+    hh = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+  h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+}
+
+// SplitMix64 — seeding and cheap uniform draws for the graph builders.
+struct SplitMix64 {
+  uint64_t s;
+  explicit SplitMix64(uint64_t seed) : s(seed) {}
+  uint64_t next() {
+    uint64_t z = (s += 0x9e3779b97f4a7c15ULL);
+    z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+    z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+    return z ^ (z >> 31);
+  }
+  // uniform in [0, bound) without modulo bias (Lemire)
+  uint64_t bounded(uint64_t bound) {
+    return (__uint128_t(next()) * bound) >> 64;
+  }
+  double uniform() { return (next() >> 11) * (1.0 / 9007199254740992.0); }
+};
+
+}  // namespace
+
+extern "C" {
+
+// out must hold 32 bytes.
+void gn_sha256(const uint8_t* data, uint64_t len, uint8_t* out) {
+  uint32_t h[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                   0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+  uint64_t full = len / 64;
+  for (uint64_t i = 0; i < full; i++) sha256_block(h, data + 64 * i);
+  uint8_t tail[128] = {0};
+  uint64_t rem = len - 64 * full;
+  std::memcpy(tail, data + 64 * full, rem);
+  tail[rem] = 0x80;
+  uint64_t tail_len = (rem + 9 <= 64) ? 64 : 128;
+  uint64_t bits = len * 8;
+  for (int i = 0; i < 8; i++)
+    tail[tail_len - 1 - i] = uint8_t(bits >> (8 * i));
+  sha256_block(h, tail);
+  if (tail_len == 128) sha256_block(h, tail + 64);
+  for (int i = 0; i < 8; i++) {
+    out[4 * i] = uint8_t(h[i] >> 24);
+    out[4 * i + 1] = uint8_t(h[i] >> 16);
+    out[4 * i + 2] = uint8_t(h[i] >> 8);
+    out[4 * i + 3] = uint8_t(h[i]);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Graph builders.  Each writes directed edges into caller-provided src/dst
+// buffers and returns the count (or -1 if cap would be exceeded).
+// ---------------------------------------------------------------------------
+
+// Reference power-law fanout (peer.cpp:219-222): per peer,
+// deg = min(cap, n * u^(1/alpha)); targets uniform != self (offset trick).
+int64_t gn_powerlaw_edges(uint64_t seed, int64_t n, double alpha,
+                          int32_t max_degree, int32_t* src, int32_t* dst,
+                          int64_t cap) {
+  if (n < 2) return 0;
+  SplitMix64 rng(seed * 0x9e3779b97f4a7c15ULL + 1);
+  int64_t e = 0;
+  for (int64_t p = 0; p < n; p++) {
+    double u = rng.uniform();
+    int64_t deg = int64_t(double(n) * std::pow(u, 1.0 / alpha));
+    deg = std::min<int64_t>({deg, n - 1, int64_t(max_degree)});
+    for (int64_t k = 0; k < deg; k++) {
+      if (e >= cap) return -1;
+      int64_t off = 1 + int64_t(rng.bounded(uint64_t(n - 1)));
+      src[e] = int32_t(p);
+      dst[e] = int32_t((p + off) % n);
+      e++;
+    }
+  }
+  return e;
+}
+
+// G(n, p) via per-peer Binomial(n-1, p)/2 out-draws — equivalent in
+// distribution to sampling m ~ Binomial(n(n-1)/2, p) undirected pairs.
+int64_t gn_er_edges(uint64_t seed, int64_t n, double avg_degree,
+                    int32_t* src, int32_t* dst, int64_t cap) {
+  if (n < 2) return 0;
+  SplitMix64 rng(seed * 0x9e3779b97f4a7c15ULL + 2);
+  // Draw the undirected pair count from a normal approximation of the
+  // binomial (exact enough for n >= 1000, the native builder's regime).
+  double mean = double(n) * avg_degree / 2.0;
+  double sd = std::sqrt(std::max(mean, 1.0));
+  double z = 0;
+  for (int i = 0; i < 12; i++) z += rng.uniform();
+  z -= 6.0;  // Irwin–Hall ~ N(0,1)
+  int64_t m = std::max<int64_t>(0, int64_t(mean + sd * z));
+  for (int64_t k = 0; k < m; k++) {
+    if (k >= cap) return -1;
+    int64_t a = int64_t(rng.bounded(uint64_t(n)));
+    int64_t off = 1 + int64_t(rng.bounded(uint64_t(n - 1)));
+    src[k] = int32_t(a);
+    dst[k] = int32_t((a + off) % n);
+  }
+  return m;
+}
+
+// Barabási–Albert preferential attachment via the repeated-endpoints
+// list (O(E) total).
+int64_t gn_ba_edges(uint64_t seed, int64_t n, int32_t m, int32_t* src,
+                    int32_t* dst, int64_t cap) {
+  if (n < 2) return 0;
+  m = std::max(1, std::min<int32_t>(m, int32_t(n - 1)));
+  SplitMix64 rng(seed * 0x9e3779b97f4a7c15ULL + 3);
+  std::vector<int32_t> endpoints;
+  endpoints.reserve(size_t(2 * m) * size_t(n));
+  int64_t e = 0;
+  int64_t m0 = m + 1;  // seed clique
+  for (int64_t i = 0; i < m0; i++)
+    for (int64_t j = i + 1; j < m0; j++) {
+      if (e >= cap) return -1;
+      src[e] = int32_t(i);
+      dst[e] = int32_t(j);
+      endpoints.push_back(int32_t(i));
+      endpoints.push_back(int32_t(j));
+      e++;
+    }
+  std::vector<int32_t> targets;
+  targets.reserve(m);
+  for (int64_t v = m0; v < n; v++) {
+    targets.clear();
+    while (int32_t(targets.size()) < m) {
+      int32_t t = endpoints[rng.bounded(endpoints.size())];
+      if (std::find(targets.begin(), targets.end(), t) == targets.end())
+        targets.push_back(t);
+    }
+    for (int32_t t : targets) {
+      if (e >= cap) return -1;
+      src[e] = int32_t(v);
+      dst[e] = t;
+      endpoints.push_back(int32_t(v));
+      endpoints.push_back(t);
+      e++;
+    }
+  }
+  return e;
+}
+
+// ---------------------------------------------------------------------------
+// Length-framed message codec (4-byte big-endian length prefix) — the
+// framing the reference's wire protocol lacks (SURVEY.md §2-C7).
+// ---------------------------------------------------------------------------
+
+// Writes prefix+payload into out (cap bytes); returns total or -1.
+int64_t gn_frame_encode(const uint8_t* payload, uint64_t len, uint8_t* out,
+                        uint64_t cap) {
+  if (len + 4 > cap || len > 0x7fffffffULL) return -1;
+  out[0] = uint8_t(len >> 24);
+  out[1] = uint8_t(len >> 16);
+  out[2] = uint8_t(len >> 8);
+  out[3] = uint8_t(len);
+  std::memcpy(out + 4, payload, len);
+  return int64_t(len + 4);
+}
+
+// Scans a receive buffer; returns the number of COMPLETE frames and
+// writes each frame's (offset, length) pair into spans (2*max_frames
+// int64 slots).  Trailing partial frames are simply not reported — the
+// caller keeps those bytes buffered, which is the fix for the
+// reference's fragmentation bug (peer.cpp:188-194).
+int64_t gn_frame_scan(const uint8_t* buf, uint64_t len, int64_t* spans,
+                      int64_t max_frames) {
+  int64_t count = 0;
+  uint64_t pos = 0;
+  while (pos + 4 <= len && count < max_frames) {
+    uint64_t flen = (uint64_t(buf[pos]) << 24) |
+                    (uint64_t(buf[pos + 1]) << 16) |
+                    (uint64_t(buf[pos + 2]) << 8) | uint64_t(buf[pos + 3]);
+    if (pos + 4 + flen > len) break;
+    spans[2 * count] = int64_t(pos + 4);
+    spans[2 * count + 1] = int64_t(flen);
+    pos += 4 + flen;
+    count++;
+  }
+  return count;
+}
+
+}  // extern "C"

@@ -111,7 +111,15 @@ def _run_jax_aligned(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
               file=sys.stderr)
         return 1
     mode = cfg.mode
-    law = "powerlaw" if cfg.graph in ("reference", "powerlaw") else "regular"
+    if cfg.graph in ("reference", "powerlaw"):
+        law = "powerlaw"
+    elif cfg.graph == "er":
+        law = "regular"        # ER == uniform slot count, the direct analogue
+    else:
+        print(f"Error: --engine aligned supports "
+              f"reference/powerlaw/er overlays, not {cfg.graph!r} "
+              "(use --engine edges for ba)", file=sys.stderr)
+        return 1
     topo = build_aligned(seed=cfg.prng_seed, n=n,
                          n_slots=min(cfg.avg_degree or 16, 127),
                          degree_law=law, powerlaw_alpha=cfg.powerlaw_alpha)
@@ -159,7 +167,8 @@ def _run_socket(cfg: NetworkConfig, args) -> int:
     if args.role == "seed":
         from p2p_gossipprotocol_tpu.seed import SeedNode
 
-        node = SeedNode(cfg.get_local_ip(), cfg.get_local_port())
+        node = SeedNode(cfg.get_local_ip(), cfg.get_local_port(),
+                        wire_format=cfg.wire_format)
         node.start()
     else:
         from p2p_gossipprotocol_tpu.wrapper import Peer

@@ -115,7 +115,9 @@ _SIM_STR_KEYS = {
     "local_ip": "local_ip",
     "backend": "backend",
     "graph": "graph",
+    "graph_backend": "graph_backend",
     "mode": "mode",
+    "wire_format": "wire_format",
 }
 
 
@@ -134,6 +136,8 @@ class NetworkConfig:
         self.local_port = 5000
         self.backend = "jax"
         self.graph = "reference"
+        self.graph_backend = "numpy"   # numpy | native (C++ builders)
+        self.wire_format = "json"      # json (reference-compat) | framed
         self.mode = "push"
         self.n_peers = 0
         self.n_messages = 0
@@ -270,6 +274,11 @@ class NetworkConfig:
             raise ConfigError(f"Unknown backend: {self.backend}")
         if self.graph not in ("reference", "er", "ba", "powerlaw"):
             raise ConfigError(f"Unknown graph model: {self.graph}")
+        if self.graph_backend not in ("numpy", "native"):
+            raise ConfigError(
+                f"Unknown graph_backend: {self.graph_backend}")
+        if self.wire_format not in ("json", "framed"):
+            raise ConfigError(f"Unknown wire_format: {self.wire_format}")
         if self.mode not in ("push", "pull", "pushpull"):
             raise ConfigError(f"Unknown gossip mode: {self.mode}")
         if not (0.0 <= self.churn_rate < 1.0):

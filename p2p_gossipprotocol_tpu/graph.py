@@ -199,9 +199,16 @@ def from_config(cfg, n_peers: int | None = None) -> Topology:
     ``graph=reference`` with no explicit ``n_peers`` simulates one peer per
     configured seed node — the README's "run in n terminals" scenario
     (reference README.md:4) collapsed into one process.
+
+    ``graph_backend=native`` routes construction through the C++ builders
+    (native/gossip_native.cpp; ~2x numpy at 1M peers, and the path sized
+    for the 10M configs).  Same laws, different RNG stream — a given seed
+    is deterministic within a backend, not across backends.
     """
     n = n_peers or cfg.n_peers or len(cfg.seed_nodes)
     g = cfg.graph
+    if getattr(cfg, "graph_backend", "numpy") == "native":
+        return _from_config_native(cfg, n)
     if g in ("reference", "powerlaw"):
         # The raw reference law has E[degree] ≈ 0.71·n (E[u^(1/2.5)] = 1/1.4,
         # peer.cpp:219-222) — quadratic edge growth.  Leave it uncapped only
@@ -219,3 +226,29 @@ def from_config(cfg, n_peers: int | None = None) -> Topology:
     if g == "ba":
         return barabasi_albert(cfg.prng_seed, n, m=cfg.ba_m)
     raise ValueError(f"Unknown graph model: {g}")
+
+
+def _from_config_native(cfg, n: int) -> Topology:
+    from p2p_gossipprotocol_tpu import native
+
+    if not native.available():
+        raise RuntimeError(
+            "graph_backend=native but the library isn't built; "
+            "run `make -C native`")
+    g = cfg.graph
+    if g in ("reference", "powerlaw"):
+        cap = (n - 1) if g == "reference" and n <= 2048 else max(
+            64, cfg.avg_degree * 8)
+        src, dst = native.powerlaw_edges(cfg.prng_seed, n,
+                                         alpha=cfg.powerlaw_alpha,
+                                         max_degree=cap)
+    elif g == "er":
+        # honor er_p exactly like the numpy path (avg degree = p*(n-1))
+        avg = cfg.er_p * (n - 1) if cfg.er_p else cfg.avg_degree
+        src, dst = native.er_edges(cfg.prng_seed, n, avg_degree=avg)
+    elif g == "ba":
+        src, dst = native.ba_edges(cfg.prng_seed, n, m=cfg.ba_m)
+    else:
+        raise ValueError(f"Unknown graph model: {g}")
+    return _pad_and_build(n, np.concatenate([src, dst]),
+                          np.concatenate([dst, src]))

@@ -12,7 +12,6 @@ message ids and sidesteps string hashing entirely).
 
 from __future__ import annotations
 
-import hashlib
 import time
 from dataclasses import dataclass, field
 
@@ -80,10 +79,15 @@ def calculate_message_hash(msg: Message) -> str:
     """SHA-256 hex over content+timestamp+sourceIP (peer.cpp:141-158).
 
     Receivers recompute this rather than trusting the wire hash
-    (peer.cpp:277) — preserved in our socket runtime.
+    (peer.cpp:277) — preserved in our socket runtime.  Uses the native
+    implementation (native/gossip_native.cpp — the analogue of the
+    reference's OpenSSL EVP path) when built, hashlib otherwise; both
+    are standard SHA-256 so identities always agree.
     """
+    from p2p_gossipprotocol_tpu import native
+
     payload = f"{msg.content}{msg.timestamp}{msg.source_ip}".encode()
-    return hashlib.sha256(payload).hexdigest()
+    return native.sha256(payload).hex()
 
 
 @dataclass

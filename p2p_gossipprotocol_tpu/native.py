@@ -1,0 +1,160 @@
+"""ctypes bindings for the native host runtime (native/gossip_native.cpp).
+
+Everything here degrades gracefully: if the shared library isn't built
+(`make -C native`), callers fall back to the pure-Python equivalents —
+hashlib for SHA-256 (bit-identical, both are standard SHA-256) and the
+numpy graph builders in graph.py.  ``available()`` reports which path is
+active; nothing imports this module's hard way at package import time.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+
+def _lib_path() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(here, "native", "libgossip_native.so")
+
+
+def _load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    path = _lib_path()
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    lib.gn_sha256.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                              ctypes.c_char_p]
+    lib.gn_sha256.restype = None
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    lib.gn_powerlaw_edges.argtypes = [
+        ctypes.c_uint64, ctypes.c_int64, ctypes.c_double, ctypes.c_int32,
+        i32p, i32p, ctypes.c_int64]
+    lib.gn_powerlaw_edges.restype = ctypes.c_int64
+    lib.gn_er_edges.argtypes = [ctypes.c_uint64, ctypes.c_int64,
+                                ctypes.c_double, i32p, i32p, ctypes.c_int64]
+    lib.gn_er_edges.restype = ctypes.c_int64
+    lib.gn_ba_edges.argtypes = [ctypes.c_uint64, ctypes.c_int64,
+                                ctypes.c_int32, i32p, i32p, ctypes.c_int64]
+    lib.gn_ba_edges.restype = ctypes.c_int64
+    lib.gn_frame_encode.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                    ctypes.c_char_p, ctypes.c_uint64]
+    lib.gn_frame_encode.restype = ctypes.c_int64
+    lib.gn_frame_scan.argtypes = [ctypes.c_char_p, ctypes.c_uint64, i64p,
+                                  ctypes.c_int64]
+    lib.gn_frame_scan.restype = ctypes.c_int64
+    _LIB = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+def sha256(data: bytes) -> bytes:
+    """SHA-256 digest — native when built, hashlib otherwise (identical
+    output; the reference links OpenSSL for the same algorithm,
+    peer.cpp:135-159)."""
+    lib = _load()
+    if lib is None:
+        import hashlib
+
+        return hashlib.sha256(data).digest()
+    out = ctypes.create_string_buffer(32)
+    lib.gn_sha256(data, len(data), out)
+    return out.raw
+
+
+# ---------------------------------------------------------------------------
+def _run_builder(fn, cap_guess: int, *args):
+    cap = cap_guess
+    for _ in range(4):
+        src = np.empty(cap, np.int32)
+        dst = np.empty(cap, np.int32)
+        n_edges = fn(*args, src, dst, cap)
+        if n_edges >= 0:
+            return src[:n_edges].copy(), dst[:n_edges].copy()
+        cap *= 2
+    raise MemoryError("native graph builder exceeded retry capacity")
+
+
+def powerlaw_edges(seed: int, n: int, alpha: float = 2.5,
+                   max_degree: int = 64):
+    """Directed edge list under the reference's power-law fanout law
+    (peer.cpp:219-222).  Returns (src, dst) int32 arrays."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library not built (make -C native)")
+    cap = int(n) * int(max_degree) + 64
+    return _run_builder(lib.gn_powerlaw_edges, cap, seed, n, alpha,
+                        max_degree)
+
+
+def er_edges(seed: int, n: int, avg_degree: float):
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library not built (make -C native)")
+    cap = int(n * avg_degree) + int(8 * (n * avg_degree) ** 0.5) + 64
+    return _run_builder(lib.gn_er_edges, cap, seed, n, avg_degree)
+
+
+def ba_edges(seed: int, n: int, m: int = 4):
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library not built (make -C native)")
+    cap = int(n) * int(m) + int(m) * int(m) + 64
+    return _run_builder(lib.gn_ba_edges, cap, seed, n, m)
+
+
+# ---------------------------------------------------------------------------
+def frame_encode(payload: bytes) -> bytes:
+    """4-byte big-endian length prefix + payload (the framing the
+    reference's unframed TCP protocol lacks, SURVEY.md §2-C7)."""
+    lib = _load()
+    if lib is None:
+        return len(payload).to_bytes(4, "big") + payload
+    cap = len(payload) + 4
+    out = ctypes.create_string_buffer(cap)
+    n = lib.gn_frame_encode(payload, len(payload), out, cap)
+    if n < 0:
+        raise ValueError("payload too large to frame")
+    return out.raw[:n]
+
+
+def frame_scan(buf: bytes, max_frames: int = 1024):
+    """Complete frames in ``buf`` as (payload, end_offset) with the
+    trailing partial bytes left to the caller's buffer."""
+    lib = _load()
+    if lib is None:
+        frames = []
+        pos = 0
+        while pos + 4 <= len(buf) and len(frames) < max_frames:
+            flen = int.from_bytes(buf[pos:pos + 4], "big")
+            if pos + 4 + flen > len(buf):
+                break
+            frames.append(buf[pos + 4:pos + 4 + flen])
+            pos += 4 + flen
+        return frames, pos
+    spans = np.empty(2 * max_frames, np.int64)
+    count = int(lib.gn_frame_scan(buf, len(buf), spans, max_frames))
+    frames = []
+    pos = 0
+    for i in range(count):
+        off, flen = int(spans[2 * i]), int(spans[2 * i + 1])
+        frames.append(buf[off:off + flen])
+        pos = off + flen
+    return frames, pos

@@ -28,7 +28,7 @@ import time
 from p2p_gossipprotocol_tpu.info import (Message, PeerInfo,
                                          calculate_message_hash)
 from p2p_gossipprotocol_tpu.transport.socket_transport import (
-    JsonStream, SocketTransport, send_json)
+    WIRE_FORMATS, SocketTransport)
 from p2p_gossipprotocol_tpu.utils.logging import NodeLogger
 
 
@@ -39,7 +39,8 @@ class PeerNode:
                  ping_interval: int = 13, message_interval: int = 5,
                  max_messages: int = 10, max_missed_pings: int = 3,
                  powerlaw_alpha: float = 2.5, log_dir: str = ".",
-                 rng: random.Random | None = None):
+                 rng: random.Random | None = None,
+                 wire_format: str = "json"):
         self.ip = ip
         self.port = port
         self.seeds = seeds
@@ -49,6 +50,9 @@ class PeerNode:
         self.max_missed_pings = max_missed_pings
         self.powerlaw_alpha = powerlaw_alpha
         self.rng = rng or random.Random()
+        # "json" = reference byte-compatible unframed wire; "framed" =
+        # length-prefixed robust mode (SURVEY.md §2-C7)
+        self._send, self._stream_cls = WIRE_FORMATS[wire_format]
 
         self.transport = SocketTransport(ip, port)
         self.running = False
@@ -134,9 +138,9 @@ class PeerNode:
         if sock is None:
             return False
         try:
-            send_json(sock, {"type": "register", "ip": self.ip,
-                             "port": self.port})
-            stream = JsonStream(sock)
+            self._send(sock, {"type": "register", "ip": self.ip,
+                              "port": self.port})
+            stream = self._stream_cls(sock)
             objs = stream.recv_objects()
             if not objs:
                 return False
@@ -195,7 +199,7 @@ class PeerNode:
             self._track(t)
 
     def _handle_client(self, conn, peer_key=None) -> None:
-        stream = JsonStream(conn)
+        stream = self._stream_cls(conn)
         try:
             while self.running:
                 objs = stream.recv_objects()
@@ -234,7 +238,7 @@ class PeerNode:
                        if s is not exclude_conn]
         for key, sock in targets:
             try:
-                send_json(sock, payload)
+                self._send(sock, payload)
             except OSError:
                 pass
 
@@ -315,8 +319,8 @@ class PeerNode:
             if s is None:
                 continue
             try:
-                send_json(s, {"type": "dead_node", "dead_ip": ip,
-                              "dead_port": port})
+                self._send(s, {"type": "dead_node", "dead_ip": ip,
+                               "dead_port": port})
             except OSError:
                 pass
             finally:

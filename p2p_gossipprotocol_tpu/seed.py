@@ -24,17 +24,19 @@ import time
 
 from p2p_gossipprotocol_tpu.info import PeerInfo
 from p2p_gossipprotocol_tpu.transport.socket_transport import (
-    JsonStream, SocketTransport, send_json)
+    WIRE_FORMATS, SocketTransport)
 from p2p_gossipprotocol_tpu.utils.logging import NodeLogger
 
 
 class SeedNode:
     """Peer registry: accept loop + thread-per-client (seed.cpp:64-79)."""
 
-    def __init__(self, ip: str, port: int, log_dir: str = "."):
+    def __init__(self, ip: str, port: int, log_dir: str = ".",
+                 wire_format: str = "json"):
         self.ip = ip
         self.port = port
         self.transport = SocketTransport(ip, port)
+        self._send, self._stream_cls = WIRE_FORMATS[wire_format]
         self.peer_list: dict[tuple[str, int], PeerInfo] = {}
         self._lock = threading.Lock()
         self.running = False
@@ -86,7 +88,7 @@ class SeedNode:
             self._threads.append(t)
 
     def _handle_client(self, conn) -> None:
-        stream = JsonStream(conn)
+        stream = self._stream_cls(conn)
         try:
             while self.running:
                 objs = stream.recv_objects()
@@ -105,7 +107,7 @@ class SeedNode:
         if rtype == "register":
             peer = PeerInfo(req["ip"], int(req["port"]), time.time())
             self.add_peer(peer)
-            send_json(conn, {
+            self._send(conn, {
                 "type": "peer_list",
                 "peers": [p.to_json() for p in self.get_peer_list()],
             })

@@ -28,6 +28,16 @@ def send_json(sock: socket.socket, obj: dict) -> None:
     sock.sendall(json.dumps(obj, separators=(",", ":")).encode())
 
 
+def send_framed(sock: socket.socket, obj: dict) -> None:
+    """Length-framed send (4-byte BE prefix) — the robust wire mode the
+    reference lacks (SURVEY.md §2-C7); codec in native/gossip_native.cpp
+    with a pure-Python fallback."""
+    from p2p_gossipprotocol_tpu import native
+
+    sock.sendall(native.frame_encode(
+        json.dumps(obj, separators=(",", ":")).encode()))
+
+
 class JsonStream:
     """Incremental JSON document splitter over a byte stream."""
 
@@ -64,6 +74,37 @@ class JsonStream:
             out.append(obj)
             self._buf = s[end:]
         return out
+
+
+class FramedStream:
+    """Length-framed counterpart of :class:`JsonStream` (same
+    ``recv_objects`` interface): complete frames are split off by the
+    native codec; partial trailing bytes stay buffered, so TCP
+    fragmentation/coalescing can never corrupt a document."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self._buf = b""
+
+    def recv_objects(self) -> list[dict] | None:
+        from p2p_gossipprotocol_tpu import native
+
+        try:
+            chunk = self.sock.recv(RECV_SIZE)
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        self._buf += chunk
+        frames, consumed = native.frame_scan(self._buf)
+        self._buf = self._buf[consumed:]
+        return [json.loads(f) for f in frames]
+
+
+WIRE_FORMATS = {
+    "json": (send_json, JsonStream),      # reference byte-compatible
+    "framed": (send_framed, FramedStream),
+}
 
 
 class SocketTransport(Transport):

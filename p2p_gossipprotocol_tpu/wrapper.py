@@ -43,6 +43,7 @@ class Peer:
                 max_messages=cfg.get_max_messages(),
                 max_missed_pings=cfg.get_max_missed_pings(),
                 powerlaw_alpha=cfg.powerlaw_alpha,
+                wire_format=cfg.wire_format,
             )
         else:
             from p2p_gossipprotocol_tpu.sim import Simulator

@@ -1,0 +1,81 @@
+"""Native runtime: SHA-256 equivalence, graph builder laws, framing codec.
+
+All tests run with or without the built library (`make -C native`) — the
+fallback paths are exercised either way; when the library IS present the
+native outputs are checked against the Python ground truths.
+"""
+
+import hashlib
+import subprocess
+
+import numpy as np
+import pytest
+
+from p2p_gossipprotocol_tpu import native
+
+
+def test_sha256_matches_hashlib():
+    for payload in (b"", b"x", b"Message from 1.2.3.4:5000" * 7,
+                    bytes(range(256)) * 17):
+        assert native.sha256(payload) == hashlib.sha256(payload).digest()
+
+
+def test_frame_roundtrip():
+    msgs = [b"{}", b'{"type":"gossip"}', b"x" * 5000, b""]
+    buf = b"".join(native.frame_encode(m) for m in msgs)
+    # plus a trailing partial frame
+    partial = native.frame_encode(b"tail-not-complete")[:-3]
+    frames, consumed = native.frame_scan(buf + partial)
+    assert frames == msgs
+    assert consumed == len(buf)
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason="native library not built")
+class TestNativeBuilders:
+    def test_build_via_make(self):
+        out = subprocess.run(["make", "-C", "native", "-q"],
+                             capture_output=True, cwd="/root/repo")
+        assert out.returncode in (0, 1)  # up to date or would rebuild
+
+    def test_powerlaw_law(self):
+        src, dst = native.powerlaw_edges(seed=7, n=20000, alpha=2.5,
+                                         max_degree=32)
+        assert src.shape == dst.shape and len(src) > 0
+        assert src.min() >= 0 and src.max() < 20000
+        assert dst.min() >= 0 and dst.max() < 20000
+        assert not (src == dst).any()            # no self loops
+        deg = np.bincount(src, minlength=20000)
+        assert deg.max() <= 32
+        # the law caps almost every peer at max_degree for n >> cap
+        assert (deg == 32).mean() > 0.9
+
+    def test_er_average_degree(self):
+        src, dst = native.er_edges(seed=3, n=50000, avg_degree=10.0)
+        avg = 2 * len(src) / 50000  # undirected pairs stored once
+        assert 9.0 < avg < 11.0
+        assert not (src == dst).any()
+
+    def test_ba_degree_distribution(self):
+        n, m = 30000, 4
+        src, dst = native.ba_edges(seed=5, n=n, m=m)
+        deg = np.bincount(src, minlength=n) + np.bincount(dst, minlength=n)
+        # scale-free: max degree far above the mean, min at least m
+        assert deg.min() >= m
+        assert deg.max() > 20 * deg.mean()
+
+    def test_determinism(self):
+        a = native.powerlaw_edges(seed=9, n=5000, max_degree=16)
+        b = native.powerlaw_edges(seed=9, n=5000, max_degree=16)
+        np.testing.assert_array_equal(a[0], b[0])
+        np.testing.assert_array_equal(a[1], b[1])
+
+    def test_native_feeds_topology(self):
+        from p2p_gossipprotocol_tpu.graph import _pad_and_build
+        from p2p_gossipprotocol_tpu.sim import Simulator
+
+        src, dst = native.powerlaw_edges(seed=1, n=4096, max_degree=12)
+        topo = _pad_and_build(
+            4096, np.concatenate([src, dst]), np.concatenate([dst, src]))
+        res = Simulator(topo=topo, n_msgs=4, mode="push", seed=0).run(16)
+        assert res.coverage[-1] > 0.99

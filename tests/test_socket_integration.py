@@ -1,0 +1,108 @@
+"""Loopback socket-mode integration (SURVEY.md §4, back-compat bullet):
+a real SeedNode + two PeerNodes on 127.0.0.1, in both wire formats —
+"json" (reference byte-compatible, unframed) and "framed" (length-
+prefixed robust mode backed by the native codec).
+
+Replaces the reference's manual n-terminal procedure (README.md:4-6)
+with an automated fixture.
+"""
+
+import random
+import socket
+import time
+
+import pytest
+
+from p2p_gossipprotocol_tpu.info import PeerInfo
+from p2p_gossipprotocol_tpu.peer import PeerNode
+from p2p_gossipprotocol_tpu.seed import SeedNode
+
+
+class _WiredRandom(random.Random):
+    """Deterministic fanout for the loopback fixture: u just below 1
+    makes the reference law count = int(n * u**(1/alpha)) pick n-1
+    candidates, and the no-op shuffle keeps them in seed-reply order
+    (registration order), so the second peer always links to the first.
+    (u == 1.0 exactly would hang random.shuffle's rejection sampler.)"""
+
+    def random(self):
+        return 0.9999999
+
+    def shuffle(self, x):
+        pass
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait(predicate, timeout=10.0, interval=0.05) -> bool:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.mark.parametrize("wire_format", ["json", "framed"])
+def test_seed_register_and_gossip(tmp_path, wire_format):
+    seed_port = _free_port()
+    seed = SeedNode("127.0.0.1", seed_port, log_dir=str(tmp_path),
+                    wire_format=wire_format)
+    seed.start()
+    seeds = [PeerInfo("127.0.0.1", seed_port)]
+    try:
+        a = PeerNode("127.0.0.1", _free_port(), seeds,
+                     message_interval=1, max_messages=3,
+                     log_dir=str(tmp_path), rng=_WiredRandom(),
+                     wire_format=wire_format)
+        assert a.start(bootstrap_timeout=5.0)
+        b = PeerNode("127.0.0.1", _free_port(), seeds,
+                     message_interval=1, max_messages=3,
+                     log_dir=str(tmp_path), rng=_WiredRandom(),
+                     wire_format=wire_format)
+        assert b.start(bootstrap_timeout=5.0)
+        try:
+            # both registered with the seed
+            assert _wait(lambda: len(seed.get_peer_list()) == 2)
+            # b connected to a (a was in b's peer_list reply)
+            assert _wait(lambda: len(b.connected_peers) >= 1)
+            # gossip flows: b generates messages; a must dedup-store them
+            def a_heard_b():
+                with a.message_lock:
+                    return any(m.source_port == b.port
+                               for m in a.message_list.values())
+            assert _wait(a_heard_b, timeout=15.0)
+            # dedup: message count stays bounded by senders' max_messages
+            with a.message_lock:
+                assert len(a.message_list) <= 6
+        finally:
+            a.stop()
+            b.stop()
+    finally:
+        seed.stop()
+
+
+def test_dead_node_notification(tmp_path):
+    """Eviction must notify the seed with dead_node — the protocol half
+    the reference defined but never sent (seed.cpp:130-138)."""
+    seed_port = _free_port()
+    seed = SeedNode("127.0.0.1", seed_port, log_dir=str(tmp_path))
+    seed.start()
+    try:
+        seed.add_peer(PeerInfo("127.0.0.1", 59999))
+        assert len(seed.get_peer_list()) == 1
+        node = PeerNode("127.0.0.1", _free_port(),
+                        [PeerInfo("127.0.0.1", seed_port)],
+                        log_dir=str(tmp_path))
+        node.running = True  # allow _handle_dead_peer without full start
+        node._handle_dead_peer("127.0.0.1", 59999)
+        assert _wait(lambda: len(seed.get_peer_list()) == 0)
+        node.stop()
+    finally:
+        seed.stop()
