@@ -102,7 +102,12 @@ def test_dead_node_notification(tmp_path):
                         log_dir=str(tmp_path))
         node.running = True  # allow _handle_dead_peer without full start
         node._handle_dead_peer("127.0.0.1", 59999)
-        assert _wait(lambda: len(seed.get_peer_list()) == 0)
+        # The dead peer must be evicted from the seed.  The notifying node
+        # then re-bootstraps (reference behavior, peer.cpp:400-404), which
+        # re-registers ITSELF with the seed — so the list ends at [node],
+        # not [].  Assert the specific dead address is gone.
+        assert _wait(lambda: ("127.0.0.1", 59999) not in
+                     {(p.ip, p.port) for p in seed.get_peer_list()})
         node.stop()
     finally:
         seed.stop()
