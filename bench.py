@@ -81,18 +81,25 @@ def _init_backend(max_tries: int = 5, probe_timeout_s: float = 90.0):
 
 
 def _bench_aligned(n, n_msgs, degree, mode):
+    """BASELINE config 4 on the scale engine: power-law overlay, 5% churn
+    (one-shot kill at round 1), liveness strikes + rewire active — the
+    same scenario _bench_edges measures, not a churn-free easier one."""
     import jax
     import numpy as np
 
     from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator,
                                                 _popcount_sum,
                                                 build_aligned)
+    from p2p_gossipprotocol_tpu.liveness import ChurnConfig
 
+    churn_rate = float(os.environ.get("GOSSIP_BENCH_CHURN", "0.05"))
     t0 = time.perf_counter()
     topo = build_aligned(seed=0, n=n, n_slots=degree,
                          degree_law="powerlaw")
     graph_s = time.perf_counter() - t0
-    sim = AlignedSimulator(topo=topo, n_msgs=n_msgs, mode=mode, seed=0)
+    sim = AlignedSimulator(topo=topo, n_msgs=n_msgs, mode=mode,
+                           churn=ChurnConfig(rate=churn_rate, kill_round=1),
+                           max_strikes=3, seed=0)
     state, _topo, rounds, wall = sim.run_to_coverage(target=0.99,
                                                      max_rounds=128)
     total_seen = int(jax.device_get(_popcount_sum(state.seen_w)))

@@ -34,7 +34,9 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
+from p2p_gossipprotocol_tpu.liveness import ChurnConfig, churn_step
 from p2p_gossipprotocol_tpu.ops.aligned_kernel import (LANES, gossip_pass,
+                                                       liveness_pass,
                                                        neighbor_ids)
 
 MAX_PACKED_MSGS = 32
@@ -120,8 +122,18 @@ def build_aligned(seed: int, n: int, n_slots: int = 16,
 
 @struct.dataclass
 class AlignedState:
+    """Bit-packed network state.  Maps to the edge engine's GossipState
+    (state.py:34-51): ``seen_w``/``frontier_w`` pack the bool[peers, msgs]
+    planes 32-per-word, ``alive_b``/``byz_w`` are the liveness and
+    adversary masks, ``strikes`` the per-slot consecutive-dead counters
+    (the vectorized 3-strike rule, reference peer.cpp:335-339) — present
+    only when liveness is enabled (None otherwise, an empty pytree leaf)."""
+
     seen_w: jax.Array      # int32[R, 128]  bit j = peer has rumor j
     frontier_w: jax.Array  # int32[R, 128]  bit j = first heard last round
+    alive_b: jax.Array     # bool [R, 128]  liveness mask
+    byz_w: jax.Array       # int32[R, 128]  -1 = byzantine peer, 0 honest
+    strikes: jax.Array | None   # int8[D, R, 128] or None
     key: jax.Array
     round: jax.Array
 
@@ -132,12 +144,25 @@ def _popcount_sum(words: jax.Array) -> jax.Array:
 
 @dataclass
 class AlignedSimulator:
-    """Same surface as sim.Simulator (run / run_to_coverage / metrics),
-    flood-push or push+anti-entropy-pull, at HBM-bandwidth speed."""
+    """Same surface as sim.Simulator (step / run / run_to_coverage, same
+    metric dict, churn + liveness/rewire + byzantine), flood-push or
+    push+anti-entropy-pull, at HBM-bandwidth speed.
+
+    Liveness semantics mirror liveness.strike_and_rewire: a slot whose
+    neighbor looks dead gains a strike per round, eviction at
+    ``max_strikes`` rewires the slot to a random replacement lane in the
+    same permuted row (accepted only if itself alive — the re-bootstrap
+    analogue, reference peer.cpp:400-404).  Byzantine peers receive but
+    never relay and refuse to serve pulls (models/gossip.py semantics);
+    junk columns >= ``n_honest_msgs`` are their injection budget."""
 
     topo: AlignedTopology
     n_msgs: int = 16
     mode: str = "push"           # push | pushpull
+    churn: ChurnConfig = None    # type: ignore[assignment]
+    byzantine_fraction: float = 0.0
+    n_honest_msgs: int | None = None   # None → all columns honest
+    max_strikes: int = 3
     seed: int = 0
     interpret: bool | None = None   # None -> interpret unless on TPU
 
@@ -147,40 +172,124 @@ class AlignedSimulator:
                 f"aligned engine packs <= {MAX_PACKED_MSGS} messages")
         if self.mode not in ("push", "pushpull"):
             raise ValueError(f"Unknown gossip mode: {self.mode}")
+        if not 0 < self.max_strikes <= 126:
+            # strikes are int8 clamped at max_strikes + 1; 127 would wrap
+            # and silently disable eviction (the edge engine's int32
+            # counters accept any value — keep the configs that work there
+            # from degrading here without a word)
+            raise ValueError("aligned engine needs 0 < max_strikes <= 126")
+        if self.churn is None:
+            self.churn = ChurnConfig()
         if self.interpret is None:
             self.interpret = jax.default_backend() not in ("tpu", "axon")
+        self._n_honest = (self.n_honest_msgs
+                          if self.n_honest_msgs is not None else self.n_msgs)
+        if not 0 < self._n_honest <= self.n_msgs:
+            raise ValueError("n_honest_msgs must be in (0, n_msgs]")
+        # Liveness (strikes/rewire) runs whenever peers can die — without
+        # churn no neighbor is ever observed dead, so the pass is skipped
+        # statically and the strike plane is never allocated.
+        self._liveness = self.churn.rate > 0.0 or self.churn.revive > 0.0
+        self._honest_mask = jnp.int32(-1 if self._n_honest >= 32
+                                      else (1 << self._n_honest) - 1)
+        self._junk_mask = (jnp.int32(-1 if self.n_msgs >= 32
+                                     else (1 << self.n_msgs) - 1)
+                           & ~self._honest_mask)
         self._run_cache: dict = {}
         self._loop_cache: dict = {}
 
     # ------------------------------------------------------------------
     def init_state(self) -> AlignedState:
-        n = self.topo.n_peers
         rows = self.topo.rows
         key = jax.random.PRNGKey(self.seed)
-        src = (jnp.arange(self.n_msgs, dtype=jnp.int32)
-               * max(n // self.n_msgs, 1)) % n
+        k_byz, key = jax.random.split(key)
+        valid_b = self.topo.valid_w != 0
+        if self.byzantine_fraction > 0.0:
+            byz_b = (jax.random.uniform(k_byz, (rows, LANES))
+                     < self.byzantine_fraction) & valid_b
+        else:
+            byz_b = jnp.zeros((rows, LANES), bool)
+        byz_w = jnp.where(byz_b, jnp.int32(-1), jnp.int32(0))
+        # Honest rumors must originate at honest peers (a byzantine source
+        # never relays — state.py:init_gossip_state has the same rule).
+        # Sources spread evenly over the honest population; columns >=
+        # n_honest start empty (the adversary's injection budget).
+        ok_flat = (valid_b & ~byz_b).reshape(-1)
+        honest_idx = jnp.nonzero(ok_flat, size=rows * LANES,
+                                 fill_value=0)[0]
+        n_ok = jnp.maximum(jnp.sum(ok_flat, dtype=jnp.int32), 1)
+        stride = jnp.maximum(n_ok // max(self._n_honest, 1), 1)
+        pos = (jnp.arange(self.n_msgs, dtype=jnp.int32) * stride) % n_ok
+        src = honest_idx[pos]
+        place = jnp.arange(self.n_msgs) < self._n_honest
         # Seed words in uint32 with scatter-ADD: distinct message bits add
         # like OR (so colliding sources keep every rumor), and bit 31
         # survives (an int32 `1 << 31` would wrap negative and be dropped
         # by a max-combiner).  Bitcast back to the engine's int32 words.
-        bits_u = jnp.zeros(rows * LANES, jnp.uint32).at[src].add(
-            jnp.uint32(1) << jnp.arange(self.n_msgs, dtype=jnp.uint32))
+        bits = jnp.where(
+            place, jnp.uint32(1) << jnp.arange(self.n_msgs,
+                                               dtype=jnp.uint32), 0)
+        bits_u = jnp.zeros(rows * LANES, jnp.uint32).at[
+            jnp.where(place, src, 0)].add(bits)
         seen = jax.lax.bitcast_convert_type(
             bits_u, jnp.int32).reshape(rows, LANES)
-        return AlignedState(seen_w=seen, frontier_w=seen, key=key,
+        strikes = (jnp.zeros((self.topo.n_slots, rows, LANES), jnp.int8)
+                   if self._liveness else None)
+        return AlignedState(seen_w=seen, frontier_w=seen, alive_b=valid_b,
+                            byz_w=byz_w, strikes=strikes, key=key,
                             round=jnp.int32(0))
 
     # ------------------------------------------------------------------
-    def step(self, state: AlignedState) -> tuple[AlignedState, dict]:
-        topo = self.topo
-        key, k_pull = jax.random.split(state.key)
+    def step(self, state: AlignedState, topo: AlignedTopology | None = None
+             ) -> tuple[AlignedState, AlignedTopology, dict]:
+        """One full round: churn → liveness/rewire → (byz inject) → gossip
+        — the same pipeline as sim.Simulator.step.  ``topo`` is carried
+        because rewiring mutates the lane-choice table (the aligned
+        analogue of the edge engine's dst mutation)."""
+        topo = self.topo if topo is None else topo
+        valid_b = topo.valid_w != 0
+        key, k_churn, k_rew, k_pull = jax.random.split(state.key, 4)
 
-        y = jnp.take(state.frontier_w, topo.perm, axis=0)
+        alive_b = state.alive_b
+        if self.churn.rate > 0.0 or self.churn.revive > 0.0:
+            alive_b = churn_step(k_churn, alive_b.reshape(-1), state.round,
+                                 self.churn).reshape(alive_b.shape) & valid_b
+        alive_w = jnp.where(alive_b, jnp.int32(-1), jnp.int32(0))
+
+        strikes = state.strikes
+        n_evict = jnp.int32(0)
+        if self._liveness:
+            y_alive = jnp.take(alive_w, topo.perm, axis=0)
+            rand = jax.random.randint(
+                k_rew, topo.colidx.shape, 0, LANES, jnp.int8)
+            colidx, strikes, evict8 = liveness_pass(
+                y_alive, topo.colidx, strikes, rand, topo.deg,
+                topo.rolls, topo.subrolls, max_strikes=self.max_strikes,
+                rowblk=topo.rowblk, interpret=self.interpret)
+            topo = topo.replace(colidx=colidx)
+            n_evict = jnp.sum(evict8, dtype=jnp.int32)
+
+        seen_w, frontier_w = state.seen_w, state.frontier_w
+        if self._n_honest < self.n_msgs:
+            # Byzantine injection (models/byzantine.py:24-38): junk bits
+            # enter every byzantine peer's seen+frontier each round.
+            inject = state.byz_w & self._junk_mask & ~seen_w
+            seen_w = seen_w | inject
+            frontier_w = frontier_w | inject
+
+        # Dead peers don't send; byzantine peers never relay (suppression,
+        # models/gossip.py:50-58) — both masked at the source words.
+        send = frontier_w & alive_w & ~state.byz_w
+        y = jnp.take(send, topo.perm, axis=0)
         recv = gossip_pass(y, topo.colidx, topo.deg, topo.rolls,
                            topo.subrolls, pull=False, rowblk=topo.rowblk,
                            interpret=self.interpret)
         if self.mode == "pushpull":
-            ys = jnp.take(state.seen_w, topo.perm, axis=0)
+            # Anti-entropy: each peer pulls one random slot's neighbor's
+            # full seen-set; dead/byzantine neighbors serve nothing
+            # (gossip.py pull_round's alive[nbr] & ~byzantine[nbr]).
+            ys = jnp.take(state.seen_w & alive_w & ~state.byz_w,
+                          topo.perm, axis=0)
             u = jax.random.randint(k_pull, (topo.rows, LANES), 0, 1 << 30,
                                    jnp.int32)
             deg32 = topo.deg.astype(jnp.int32)
@@ -192,78 +301,108 @@ class AlignedSimulator:
                                       rowblk=topo.rowblk,
                                       interpret=self.interpret)
 
-        recv = recv & topo.valid_w
-        new = recv & ~state.seen_w
-        seen = state.seen_w | new
+        # Dead peers don't receive (the link is gone — gossip.py:_advance).
+        recv = recv & topo.valid_w & alive_w
+        new = recv & ~seen_w
+        seen = seen_w | new
         # In this engine deliveries == frontier bits by construction (every
         # first receipt enters the next frontier); both keys are kept for
         # surface parity with sim.Simulator's metric dict.
         deliveries = _popcount_sum(new)
-        coverage = (_popcount_sum(seen).astype(jnp.float32)
-                    / (topo.n_peers * self.n_msgs))
-        state = AlignedState(seen_w=seen, frontier_w=new, key=key,
+        # Coverage over honest columns of LIVE HONEST peers — the edge
+        # engine's coverage_of (sim.py:33-43).  Each ok peer contributes 32
+        # bits to popcount(ok_w), hence the >> 5 peer count.
+        ok_w = alive_w & ~state.byz_w & topo.valid_w
+        n_ok = jnp.maximum(_popcount_sum(ok_w) >> 5, 1)
+        coverage = (_popcount_sum(seen & ok_w & self._honest_mask)
+                    .astype(jnp.float32)
+                    / (n_ok.astype(jnp.float32) * self._n_honest))
+        live = _popcount_sum(alive_w & topo.valid_w) >> 5
+        state = AlignedState(seen_w=seen, frontier_w=new, alive_b=alive_b,
+                             byz_w=state.byz_w, strikes=strikes, key=key,
                              round=state.round + 1)
-        return state, {"coverage": coverage, "deliveries": deliveries,
-                       "frontier_size": deliveries}
+        return state, topo, {"coverage": coverage, "deliveries": deliveries,
+                             "frontier_size": deliveries,
+                             "live_peers": live, "evictions": n_evict}
 
     # ------------------------------------------------------------------
     def run(self, rounds: int, state: AlignedState | None = None,
-            warmup: bool = False):
-        """``warmup=True`` executes the compiled program once before the
+            topo: AlignedTopology | None = None, warmup: bool = False):
+        """Fixed-round scan with full metric history; returns the same
+        :class:`sim.SimResult` as the edge engine.
+
+        ``warmup=True`` executes the compiled program once before the
         timed run, so ``wall`` excludes compilation AND the one-time
         program-upload cost remote PJRT backends pay on first execution
         (measured ~1.7 s on a tunneled chip vs ~4 ms/round steady-state)."""
         import time as _time
 
+        from p2p_gossipprotocol_tpu.sim import SimResult
+
         state = self.init_state() if state is None else state
+        topo = self.topo if topo is None else topo
         if rounds not in self._run_cache:
-            def scan_fn(st):
+            def scan_fn(st, tp):
                 def body(carry, _):
-                    st, metrics = self.step(carry)
-                    return st, metrics
-                return jax.lax.scan(body, st, None, length=rounds)
+                    s, t = carry
+                    s, t, metrics = self.step(s, t)
+                    return (s, t), metrics
+                return jax.lax.scan(body, (st, tp), None, length=rounds)
             self._run_cache[rounds] = jax.jit(scan_fn)
         fn = self._run_cache[rounds]
         if warmup:
-            out = fn(state)
-            jax.device_get(out[0].round)
+            out = fn(state, topo)
+            jax.device_get(out[0][0].round)
         t0 = _time.perf_counter()
-        state, ys = fn(state)
-        rounds_done = int(jax.device_get(state.round))  # forces completion
+        (state, topo), ys = fn(state, topo)
+        int(jax.device_get(state.round))  # forces completion
         wall = _time.perf_counter() - t0
-        return state, {k: np.asarray(v) for k, v in ys.items()}, wall
+        return SimResult(
+            state=state, topo=topo,
+            coverage=np.asarray(ys["coverage"]),
+            deliveries=np.asarray(ys["deliveries"]),
+            frontier_size=np.asarray(ys["frontier_size"]),
+            live_peers=np.asarray(ys["live_peers"]),
+            evictions=np.asarray(ys["evictions"]),
+            wall_s=wall,
+        )
 
     def run_to_coverage(self, target: float = 0.99, max_rounds: int = 256,
                         state: AlignedState | None = None,
+                        topo: AlignedTopology | None = None,
                         warmup: bool = True):
         """(state, topo, rounds_run, wall_s) — same 4-tuple shape as
-        sim.Simulator.run_to_coverage.  Compile and (with ``warmup``)
-        first-execution program-upload excluded; completion forced via a
-        scalar device_get, so the wall-clock is honest."""
+        sim.Simulator.run_to_coverage.  ``topo`` must be passed when
+        resuming a churned run (rewire mutates the lane table).  Compile
+        and (with ``warmup``) first-execution program-upload excluded;
+        completion forced via a scalar device_get, so the wall-clock is
+        honest."""
         import time as _time
 
         state = self.init_state() if state is None else state
+        topo = self.topo if topo is None else topo
         cache_key = (target, max_rounds)
         if cache_key not in self._loop_cache:
-            def looped(st):
+            def looped(st, tp):
                 def cond(carry):
-                    st, cov = carry
+                    st, tp, cov = carry
                     return (cov < target) & (st.round < max_rounds)
 
                 def body(carry):
-                    st, _ = carry
-                    st, metrics = self.step(st)
-                    return st, metrics["coverage"]
+                    st, tp, _ = carry
+                    st, tp, metrics = self.step(st, tp)
+                    return st, tp, metrics["coverage"]
 
-                return jax.lax.while_loop(cond, body, (st, jnp.float32(0)))
+                return jax.lax.while_loop(cond, body,
+                                          (st, tp, jnp.float32(0)))
             fn = jax.jit(looped)
-            self._loop_cache[cache_key] = fn.lower(state).compile()
+            self._loop_cache[cache_key] = fn.lower(state, topo).compile()
         fn_c = self._loop_cache[cache_key]
         if warmup:
-            out = fn_c(state)
+            out = fn_c(state, topo)
             jax.device_get(out[0].round)
         t0 = _time.perf_counter()
-        st, cov = fn_c(state)
+        st, tp, cov = fn_c(state, topo)
         rounds_run = int(jax.device_get(st.round))
         wall = _time.perf_counter() - t0
-        return st, self.topo, rounds_run, wall
+        return st, tp, rounds_run, wall

@@ -76,38 +76,28 @@ def _run_jax(cfg: NetworkConfig, args) -> int:
                   f"{sim.n_msgs} messages, mode={sim.mode}, "
                   f"{int(sim.topo.n_edges())} edges")
         res = sim.run(rounds)
-    if not args.quiet:
-        for i in range(len(res.coverage)):
-            print(f"round {i + 1:4d}  coverage={res.coverage[i]:.4f}  "
-                  f"frontier={res.frontier_size[i]:8d}  "
-                  f"live={res.live_peers[i]:8d}  "
-                  f"evictions={res.evictions[i]:6d}")
-            if res.coverage[i] >= 0.999999 and res.frontier_size[i] == 0:
-                break
-    if args.metrics_jsonl:
-        with open(args.metrics_jsonl, "w") as fp:
-            metrics_lib.emit_jsonl(metrics_lib.rows_from_result(res), fp,
-                                   n_peers=sim.topo.n_peers,
-                                   mode=sim.mode, engine="edges")
-    print(json.dumps({
-        "n_peers": sim.topo.n_peers,
-        "n_msgs": sim.n_msgs,
-        "mode": sim.mode,
-        "engine": "edges",
-        "rounds_run": rounds,
-        **metrics_lib.summarize(res, args.target_coverage),
-    }))
+    _report(res, sim, n_peers=sim.topo.n_peers, engine="edges",
+            rounds=rounds, args=args, metrics_lib=metrics_lib)
     return 0
 
 
 def _run_jax_aligned(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
     from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator,
                                                 build_aligned)
+    from p2p_gossipprotocol_tpu.liveness import ChurnConfig
 
     n = args.n_peers or cfg.n_peers or len(cfg.seed_nodes)
     if cfg.mode not in ("push", "pushpull"):
         print(f"Error: --engine aligned supports push/pushpull, "
               f"not {cfg.mode!r} (use --engine edges for pull)",
+              file=sys.stderr)
+        return 1
+    if cfg.fanout:
+        # Never silently weaken the configured scenario: the aligned
+        # engine floods all degree slots (the reference's broadcast);
+        # bounded-fanout rumor mongering needs the exact engine.
+        print("Error: --engine aligned does not support fanout "
+              "(use --engine edges, or drop fanout for flood)",
               file=sys.stderr)
         return 1
     mode = cfg.mode
@@ -124,33 +114,55 @@ def _run_jax_aligned(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
                          n_slots=min(cfg.avg_degree or 16, 127),
                          degree_law=law, powerlaw_alpha=cfg.powerlaw_alpha)
     n_msgs = min(cfg.n_messages or cfg.max_message_count, 32)
-    sim = AlignedSimulator(topo=topo, n_msgs=n_msgs, mode=mode,
-                           seed=cfg.prng_seed)
+    n_honest = None
+    if cfg.byzantine_fraction > 0.0:
+        n_junk = max(1, n_msgs // 4)
+        if n_msgs + n_junk > 32:
+            n_msgs = 32 - n_junk
+        n_honest = n_msgs
+        n_msgs = n_msgs + n_junk
+    sim = AlignedSimulator(
+        topo=topo, n_msgs=n_msgs, mode=mode,
+        churn=ChurnConfig(rate=cfg.churn_rate),
+        byzantine_fraction=cfg.byzantine_fraction,
+        n_honest_msgs=n_honest,
+        max_strikes=cfg.max_missed_pings,
+        seed=cfg.prng_seed)
     if not args.quiet:
         print(f"[jax/aligned] simulating {n} peers, {n_msgs} messages, "
-              f"mode={mode}, {sim.topo.n_slots} slots/peer")
-    state, ys, wall = sim.run(rounds)
-    cov = ys["coverage"]
-    if args.metrics_jsonl:
-        rows = [{k: v[i] for k, v in ys.items()}
-                for i in range(len(cov))]
-        with open(args.metrics_jsonl, "w") as fp:
-            metrics_lib.emit_jsonl(rows, fp, n_peers=n, mode=mode,
-                                   engine="aligned")
-    hit = (cov >= args.target_coverage).nonzero()[0]
-    print(json.dumps({
-        "n_peers": n,
-        "n_msgs": n_msgs,
-        "mode": mode,
-        "engine": "aligned",
-        "rounds_run": rounds,
-        "final_coverage": float(cov[-1]),
-        f"rounds_to_{args.target_coverage:g}":
-            int(hit[0]) + 1 if hit.size else -1,
-        "total_deliveries": int(ys["deliveries"].sum()),
-        "wall_s": round(wall, 4),
-    }))
+              f"mode={mode}, {sim.topo.n_slots} slots/peer, "
+              f"churn={cfg.churn_rate:g}, "
+              f"byzantine={cfg.byzantine_fraction:g}")
+    res = sim.run(rounds)
+    _report(res, sim, n_peers=n, engine="aligned", rounds=rounds,
+            args=args, metrics_lib=metrics_lib)
     return 0
+
+
+def _report(res, sim, *, n_peers, engine, rounds, args, metrics_lib):
+    """Shared per-round printout + JSONL + summary line for both engines
+    (they return the same SimResult)."""
+    if not args.quiet:
+        for i in range(len(res.coverage)):
+            print(f"round {i + 1:4d}  coverage={res.coverage[i]:.4f}  "
+                  f"frontier={res.frontier_size[i]:8d}  "
+                  f"live={res.live_peers[i]:8d}  "
+                  f"evictions={res.evictions[i]:6d}")
+            if res.coverage[i] >= 0.999999 and res.frontier_size[i] == 0:
+                break
+    if args.metrics_jsonl:
+        with open(args.metrics_jsonl, "w") as fp:
+            metrics_lib.emit_jsonl(metrics_lib.rows_from_result(res), fp,
+                                   n_peers=n_peers, mode=sim.mode,
+                                   engine=engine)
+    print(json.dumps({
+        "n_peers": n_peers,
+        "n_msgs": sim.n_msgs,
+        "mode": sim.mode,
+        "engine": engine,
+        "rounds_run": rounds,
+        **metrics_lib.summarize(res, args.target_coverage),
+    }))
 
 
 def _run_socket(cfg: NetworkConfig, args) -> int:

@@ -110,6 +110,96 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
     )(rolls, subrolls, y, colidx, gate)
 
 
+def _liveness_kernel(max_strikes, rolls_ref, subrolls_ref, y_ref, col_ref,
+                     strikes_ref, rand_ref, gate_ref,
+                     col_out, strikes_out, evict_out):
+    """Per-slot liveness observation + 3-strike eviction + in-row rewire.
+
+    Vectorizes the reference's pingLoop/handleDeadPeer pair
+    (peer.cpp:320-355, 381-405) with the semantics of
+    liveness.strike_and_rewire: an edge whose neighbor looks dead gains a
+    strike, a live observation clears the counter (failedPings reset,
+    peer.cpp:341-344), and at ``max_strikes`` the slot is rewired to a
+    random replacement — here a fresh LANE in the same permuted row (the
+    aligned family's structural unit), accepted only if that candidate is
+    itself alive, else retried in later rounds.  Strikes are clamped at
+    ``max_strikes + 1`` so an un-rewireable slot cannot overflow int8 and
+    the ``== max_strikes`` first-crossing (the eviction count) fires once.
+    """
+    d = pl.program_id(1)
+    blk = y_ref.shape[0]
+    y = pltpu.roll(y_ref[:], blk - subrolls_ref[d], axis=0)
+    col = col_ref[0].astype(jnp.int32)
+    nbr_alive = jnp.take_along_axis(y, col, axis=1) != 0
+    g = gate_ref[:].astype(jnp.int32)
+    is_edge = d < g
+    s = strikes_ref[0].astype(jnp.int32)
+    dead_obs = is_edge & ~nbr_alive
+    s_new = jnp.where(dead_obs,
+                      jnp.minimum(s + 1, max_strikes + 1), 0)
+    evict = s_new >= max_strikes
+    cand = rand_ref[0].astype(jnp.int32)
+    cand_alive = jnp.take_along_axis(y, cand, axis=1) != 0
+    take = evict & cand_alive
+    col_out[0] = jnp.where(take, cand, col).astype(jnp.int8)
+    strikes_out[0] = jnp.where(take, 0, s_new).astype(jnp.int8)
+    evict_out[0] = (s_new == max_strikes).astype(jnp.int8)
+
+
+def liveness_pass(y_alive: jax.Array, colidx: jax.Array,
+                  strikes: jax.Array, rand_lanes: jax.Array,
+                  gate: jax.Array, rolls: jax.Array, subrolls: jax.Array,
+                  *, max_strikes: int = 3, rowblk: int = 512,
+                  interpret: bool = False
+                  ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One liveness round over every slot of every peer.
+
+    ``y_alive``    int32[R, 128]   — row-permuted alive words (-1 live, 0
+                                     dead), same permutation as the gossip
+                                     pass so slot d's neighbor-alive bit is
+                                     one dynamic_gather away
+    ``colidx``     int8 [D, R, 128] — current lane choices (mutated here)
+    ``strikes``    int8 [D, R, 128] — consecutive dead observations
+    ``rand_lanes`` int8 [D, R, 128] — this round's rewire candidates
+    ``gate``       int8 [R, 128]    — per-peer degree (slots >= gate inert)
+    Returns ``(colidx', strikes', evictions int8[D, R, 128])`` where the
+    eviction mask marks first crossings of the strike threshold.
+    """
+    R, C = y_alive.shape
+    assert C == LANES, f"lane dim must be {LANES}, got {C}"
+    D = colidx.shape[0]
+    blk = min(rowblk, R)
+    assert R % blk == 0
+    T = R // blk
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(T, D),
+        in_specs=[
+            pl.BlockSpec((blk, C), lambda t, d, k, s: ((t + k[d]) % T, 0)),
+            pl.BlockSpec((1, blk, C), lambda t, d, k, s: (d, t, 0)),
+            pl.BlockSpec((1, blk, C), lambda t, d, k, s: (d, t, 0)),
+            pl.BlockSpec((1, blk, C), lambda t, d, k, s: (d, t, 0)),
+            pl.BlockSpec((blk, C), lambda t, d, k, s: (t, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk, C), lambda t, d, k, s: (d, t, 0)),
+            pl.BlockSpec((1, blk, C), lambda t, d, k, s: (d, t, 0)),
+            pl.BlockSpec((1, blk, C), lambda t, d, k, s: (d, t, 0)),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_liveness_kernel, max_strikes),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((D, R, C), jnp.int8),
+            jax.ShapeDtypeStruct((D, R, C), jnp.int8),
+            jax.ShapeDtypeStruct((D, R, C), jnp.int8),
+        ],
+        interpret=interpret,
+    )(rolls, subrolls, y_alive, colidx, strikes, rand_lanes, gate)
+
+
 def neighbor_ids(perm, rolls, subrolls, colidx, *, rowblk: int = 512):
     """Reference (host/XLA) computation of the composite neighbor map —
     the ground truth the kernel is tested against, and the bridge that

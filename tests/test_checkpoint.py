@@ -36,16 +36,26 @@ def test_edge_engine_resume_bitwise(tmp_path):
 
 
 def test_aligned_engine_resume_bitwise(tmp_path):
+    """Churn on, so the checkpoint must carry the whole mutable world:
+    seen/frontier words, alive mask, strike counters AND the rewired
+    lane-choice topology."""
     topo = build_aligned(seed=2, n=1024, n_slots=6)
-    sim = AlignedSimulator(topo=topo, n_msgs=8, mode="pushpull", seed=3)
+    sim = AlignedSimulator(topo=topo, n_msgs=8, mode="pushpull",
+                           churn=ChurnConfig(rate=0.05, kill_round=1),
+                           seed=3)
 
-    full, _, _ = sim.run(8)
+    full = sim.run(8)
 
-    half, _, _ = sim.run(4)
-    checkpoint.save(str(tmp_path / "ck"), half)
-    restored = checkpoint.restore(str(tmp_path / "ck"), half)
-    resumed, _, _ = sim.run(4, state=restored)
+    half = sim.run(4)
+    ck = {"state": half.state, "topo": half.topo}
+    checkpoint.save(str(tmp_path / "ck"), ck)
+    restored = checkpoint.restore(str(tmp_path / "ck"), ck)
+    resumed = sim.run(4, state=restored["state"], topo=restored["topo"])
 
-    np.testing.assert_array_equal(np.asarray(resumed.seen_w),
-                                  np.asarray(full.seen_w))
-    assert int(resumed.round) == int(full.round) == 8
+    np.testing.assert_array_equal(np.asarray(resumed.state.seen_w),
+                                  np.asarray(full.state.seen_w))
+    np.testing.assert_array_equal(np.asarray(resumed.state.alive_b),
+                                  np.asarray(full.state.alive_b))
+    np.testing.assert_array_equal(np.asarray(resumed.topo.colidx),
+                                  np.asarray(full.topo.colidx))
+    assert int(resumed.state.round) == int(full.state.round) == 8
