@@ -102,6 +102,10 @@ def _bench_aligned(n, n_msgs, degree, mode):
                            max_strikes=3, seed=0)
     state, _topo, rounds, wall = sim.run_to_coverage(target=0.99,
                                                      max_rounds=128)
+    if rounds >= 128:
+        raise RuntimeError(
+            f"did not reach 99% coverage within {rounds} rounds "
+            "(churned scenario failed to converge — not a valid result)")
     total_seen = int(jax.device_get(_popcount_sum(state.seen_w)))
     n_edges = int(np.asarray(topo.deg).sum())
     return rounds, wall, total_seen, n_edges, graph_s
@@ -122,6 +126,10 @@ def _bench_edges(n, n_msgs, degree, mode):
                     max_strikes=3, rewire=True, seed=0)
     state, _t, rounds, wall = sim.run_to_coverage(target=0.99,
                                                   max_rounds=128)
+    if rounds >= 128:
+        raise RuntimeError(
+            f"did not reach 99% coverage within {rounds} rounds "
+            "(churned scenario failed to converge — not a valid result)")
     total_seen = int(jax.device_get(state.seen.sum()))
     import numpy as np
     n_edges = int(np.asarray(topo.edge_mask).sum())

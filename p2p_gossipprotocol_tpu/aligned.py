@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from p2p_gossipprotocol_tpu.liveness import ChurnConfig, churn_step
+from p2p_gossipprotocol_tpu.liveness import ChurnConfig
 from p2p_gossipprotocol_tpu.ops.aligned_kernel import (LANES, gossip_pass,
                                                        liveness_pass,
                                                        neighbor_ids)
@@ -69,7 +69,7 @@ class AlignedTopology:
 def build_aligned(seed: int, n: int, n_slots: int = 16,
                   degree_law: str = "regular",
                   powerlaw_alpha: float = 2.5,
-                  rowblk: int = 512) -> AlignedTopology:
+                  rowblk: int = 512, n_shards: int = 1) -> AlignedTopology:
     """Sample an aligned overlay for ``n`` peers with ``n_slots`` in-edge
     slots per peer.
 
@@ -78,15 +78,31 @@ def build_aligned(seed: int, n: int, n_slots: int = 16,
         degree == n_slots);
       * ``powerlaw`` — the reference's law ``deg = min(cap, n * u^(1/a))``
         (peer.cpp:219-222) with cap = n_slots.
+
+    ``n_shards`` rounds the row count so it splits into equal per-shard
+    row-block groups for AlignedShardedSimulator (1 = single-chip layout;
+    the tables are identical for any n_shards that divides the rounded
+    row count, so a sharded topo also runs unsharded).
     """
     if n_slots > 127:
         raise ValueError("n_slots must fit int8 gating (<= 127)")
     rng = np.random.default_rng(seed)
-    rows = -(-n // LANES)
-    rows = max(8, -(-rows // 8) * 8)          # tile-aligned sublane count
-    blk = min(rowblk, rows)
-    if rows % blk:
-        rows = -(-rows // blk) * blk
+    rows0 = max(1, -(-n // LANES))
+    # Padding peers are black holes (they listen to no one, so slots
+    # pointing at them are wasted in-degree) — keep them under ~6% while
+    # preferring 8-row (sublane-tile) alignment per shard.  The row-block
+    # size is then the largest DIVISOR of the per-shard rows <= rowblk,
+    # preferring multiples of 8; choosing a divisor instead of rounding
+    # rows up to blk*n_shards is what bounds the padding (rounding up
+    # would add ~26% phantom peers at the 10M/64-shard config).
+    for align in (8, 4, 2, 1):
+        rows = -(-max(rows0, 8) // (align * n_shards)) * align * n_shards
+        if rows - rows0 <= max(rows0 // 16, 0) or align == 1:
+            break
+    local = rows // n_shards
+    cap = min(rowblk, local)
+    blk = next((d for d in range(cap - cap % 8, 0, -8) if local % d == 0),
+               0) or next(d for d in range(cap, 0, -1) if local % d == 0)
     t_blocks = rows // blk
 
     perm = rng.permutation(rows).astype(np.int32)
@@ -140,6 +156,46 @@ class AlignedState:
 
 def _popcount_sum(words: jax.Array) -> jax.Array:
     return jnp.sum(jax.lax.population_count(words), dtype=jnp.int32)
+
+
+# ----------------------------------------------------------------------
+# Shard-invariant per-row randomness.  Every random decision is keyed on
+# the GLOBAL row id via fold_in, so a shard drawing only its own rows gets
+# bit-identical values to the unsharded engine drawing all rows — the
+# discipline that makes "1 device vs N devices vs unsharded" an exact,
+# testable property (same contract as parallel/sharded_sim.py, but O(local
+# rows) instead of O(global peers) per device).
+
+def row_uniform(key: jax.Array, grows: jax.Array,
+                shape: tuple) -> jax.Array:
+    """float32[len(grows), *shape] — U(0,1) per global row id."""
+    return jax.vmap(
+        lambda r: jax.random.uniform(jax.random.fold_in(key, r), shape)
+    )(grows)
+
+
+def row_randint(key: jax.Array, grows: jax.Array, shape: tuple,
+                lo: int, hi: int, dtype=jnp.int32) -> jax.Array:
+    """ints[len(grows), *shape] in [lo, hi) per global row id."""
+    return jax.vmap(
+        lambda r: jax.random.randint(jax.random.fold_in(key, r), shape,
+                                     lo, hi, dtype)
+    )(grows)
+
+
+def churn_rows(key: jax.Array, grows: jax.Array, alive_b: jax.Array,
+               valid_b: jax.Array, round_idx: jax.Array,
+               cfg: ChurnConfig) -> jax.Array:
+    """liveness.churn_step semantics on the [rows, 128] peer grid with
+    per-row shard-invariant draws; padding peers can never revive."""
+    u = row_uniform(key, grows, (2, LANES))
+    u_die, u_rev = u[:, 0], u[:, 1]
+    if cfg.kill_round >= 0:
+        dies = (round_idx == cfg.kill_round) & (u_die < cfg.rate)
+    else:
+        dies = u_die < cfg.rate
+    revives = u_rev < cfg.revive
+    return ((alive_b & ~dies) | (~alive_b & revives)) & valid_b
 
 
 @dataclass
@@ -247,83 +303,11 @@ class AlignedSimulator:
         because rewiring mutates the lane-choice table (the aligned
         analogue of the edge engine's dst mutation)."""
         topo = self.topo if topo is None else topo
-        valid_b = topo.valid_w != 0
-        key, k_churn, k_rew, k_pull = jax.random.split(state.key, 4)
+        grows = jnp.arange(topo.rows, dtype=jnp.int32)
+        return aligned_round(self, state, topo, grows=grows,
+                             t_off=jnp.int32(0),
+                             gather=lambda x: x, reduce=lambda x: x)
 
-        alive_b = state.alive_b
-        if self.churn.rate > 0.0 or self.churn.revive > 0.0:
-            alive_b = churn_step(k_churn, alive_b.reshape(-1), state.round,
-                                 self.churn).reshape(alive_b.shape) & valid_b
-        alive_w = jnp.where(alive_b, jnp.int32(-1), jnp.int32(0))
-
-        strikes = state.strikes
-        n_evict = jnp.int32(0)
-        if self._liveness:
-            y_alive = jnp.take(alive_w, topo.perm, axis=0)
-            rand = jax.random.randint(
-                k_rew, topo.colidx.shape, 0, LANES, jnp.int8)
-            colidx, strikes, evict8 = liveness_pass(
-                y_alive, topo.colidx, strikes, rand, topo.deg,
-                topo.rolls, topo.subrolls, max_strikes=self.max_strikes,
-                rowblk=topo.rowblk, interpret=self.interpret)
-            topo = topo.replace(colidx=colidx)
-            n_evict = jnp.sum(evict8, dtype=jnp.int32)
-
-        seen_w, frontier_w = state.seen_w, state.frontier_w
-        if self._n_honest < self.n_msgs:
-            # Byzantine injection (models/byzantine.py:24-38): junk bits
-            # enter every byzantine peer's seen+frontier each round.
-            inject = state.byz_w & self._junk_mask & ~seen_w
-            seen_w = seen_w | inject
-            frontier_w = frontier_w | inject
-
-        # Dead peers don't send; byzantine peers never relay (suppression,
-        # models/gossip.py:50-58) — both masked at the source words.
-        send = frontier_w & alive_w & ~state.byz_w
-        y = jnp.take(send, topo.perm, axis=0)
-        recv = gossip_pass(y, topo.colidx, topo.deg, topo.rolls,
-                           topo.subrolls, pull=False, rowblk=topo.rowblk,
-                           interpret=self.interpret)
-        if self.mode == "pushpull":
-            # Anti-entropy: each peer pulls one random slot's neighbor's
-            # full seen-set; dead/byzantine neighbors serve nothing
-            # (gossip.py pull_round's alive[nbr] & ~byzantine[nbr]).
-            ys = jnp.take(state.seen_w & alive_w & ~state.byz_w,
-                          topo.perm, axis=0)
-            u = jax.random.randint(k_pull, (topo.rows, LANES), 0, 1 << 30,
-                                   jnp.int32)
-            deg32 = topo.deg.astype(jnp.int32)
-            delta = (u % jnp.maximum(deg32, 1)).astype(jnp.int8)
-            delta = jnp.where(deg32 > 0, delta,
-                              jnp.int8(self.topo.n_slots))  # no contact
-            recv = recv | gossip_pass(ys, topo.colidx, delta, topo.rolls,
-                                      topo.subrolls, pull=True,
-                                      rowblk=topo.rowblk,
-                                      interpret=self.interpret)
-
-        # Dead peers don't receive (the link is gone — gossip.py:_advance).
-        recv = recv & topo.valid_w & alive_w
-        new = recv & ~seen_w
-        seen = seen_w | new
-        # In this engine deliveries == frontier bits by construction (every
-        # first receipt enters the next frontier); both keys are kept for
-        # surface parity with sim.Simulator's metric dict.
-        deliveries = _popcount_sum(new)
-        # Coverage over honest columns of LIVE HONEST peers — the edge
-        # engine's coverage_of (sim.py:33-43).  Each ok peer contributes 32
-        # bits to popcount(ok_w), hence the >> 5 peer count.
-        ok_w = alive_w & ~state.byz_w & topo.valid_w
-        n_ok = jnp.maximum(_popcount_sum(ok_w) >> 5, 1)
-        coverage = (_popcount_sum(seen & ok_w & self._honest_mask)
-                    .astype(jnp.float32)
-                    / (n_ok.astype(jnp.float32) * self._n_honest))
-        live = _popcount_sum(alive_w & topo.valid_w) >> 5
-        state = AlignedState(seen_w=seen, frontier_w=new, alive_b=alive_b,
-                             byz_w=state.byz_w, strikes=strikes, key=key,
-                             round=state.round + 1)
-        return state, topo, {"coverage": coverage, "deliveries": deliveries,
-                             "frontier_size": deliveries,
-                             "live_peers": live, "evictions": n_evict}
 
     # ------------------------------------------------------------------
     def run(self, rounds: int, state: AlignedState | None = None,
@@ -406,3 +390,98 @@ class AlignedSimulator:
         rounds_run = int(jax.device_get(st.round))
         wall = _time.perf_counter() - t0
         return st, tp, rounds_run, wall
+
+
+def aligned_round(sim: AlignedSimulator, state: AlignedState,
+                  topo: AlignedTopology, *, grows: jax.Array,
+                  t_off: jax.Array, gather, reduce
+                  ) -> tuple[AlignedState, AlignedTopology, dict]:
+    """THE round implementation, shared by the single-chip engine and
+    AlignedShardedSimulator (parallel/aligned_sharded.py).
+
+    The two callers differ only in how rows map to the global grid:
+      * ``grows``  — this caller's rows' GLOBAL row ids (per-row RNG keys);
+      * ``t_off``  — this caller's first row-block index (offsets the
+        kernel's per-slot block rolls);
+      * ``gather`` — identity, or ``all_gather`` over the mesh axis (makes
+        the row-permuted sender/alive words global before the kernels);
+      * ``reduce`` — identity, or ``psum`` (metric reduction).
+    Everything else — churn, strikes/rewire, byzantine, gossip passes,
+    metrics — is this one code path, so the engines cannot drift."""
+    valid_b = topo.valid_w != 0
+    key, k_churn, k_rew, k_pull = jax.random.split(state.key, 4)
+
+    alive_b = state.alive_b
+    if sim.churn.rate > 0.0 or sim.churn.revive > 0.0:
+        alive_b = churn_rows(k_churn, grows, alive_b, valid_b,
+                             state.round, sim.churn)
+    alive_w = jnp.where(alive_b, jnp.int32(-1), jnp.int32(0))
+
+    strikes = state.strikes
+    n_evict = jnp.int32(0)
+    rolls_off = topo.rolls + t_off
+    if sim._liveness:
+        y_alive = jnp.take(gather(alive_w), topo.perm, axis=0)
+        rand = row_randint(k_rew, grows, (topo.n_slots, LANES),
+                           0, LANES, jnp.int8).transpose(1, 0, 2)
+        colidx, strikes, evict8 = liveness_pass(
+            y_alive, topo.colidx, strikes, rand, topo.deg,
+            rolls_off, topo.subrolls, max_strikes=sim.max_strikes,
+            rowblk=topo.rowblk, interpret=sim.interpret)
+        topo = topo.replace(colidx=colidx)
+        n_evict = reduce(jnp.sum(evict8, dtype=jnp.int32))
+
+    seen_w, frontier_w = state.seen_w, state.frontier_w
+    if sim._n_honest < sim.n_msgs:
+        # Byzantine injection (models/byzantine.py:24-38): junk bits
+        # enter every byzantine peer's seen+frontier each round.
+        inject = state.byz_w & sim._junk_mask & ~seen_w
+        seen_w = seen_w | inject
+        frontier_w = frontier_w | inject
+
+    # Dead peers don't send; byzantine peers never relay (suppression,
+    # models/gossip.py:50-58) — both masked at the source words.
+    send = frontier_w & alive_w & ~state.byz_w
+    y = jnp.take(gather(send), topo.perm, axis=0)
+    recv = gossip_pass(y, topo.colidx, topo.deg, rolls_off,
+                       topo.subrolls, pull=False, rowblk=topo.rowblk,
+                       interpret=sim.interpret)
+    if sim.mode == "pushpull":
+        # Anti-entropy: each peer pulls one random slot's neighbor's
+        # full seen-set; dead/byzantine neighbors serve nothing
+        # (gossip.py pull_round's alive[nbr] & ~byzantine[nbr]).
+        ys = jnp.take(gather(state.seen_w & alive_w & ~state.byz_w),
+                      topo.perm, axis=0)
+        u = row_randint(k_pull, grows, (LANES,), 0, 1 << 30, jnp.int32)
+        deg32 = topo.deg.astype(jnp.int32)
+        delta = (u % jnp.maximum(deg32, 1)).astype(jnp.int8)
+        delta = jnp.where(deg32 > 0, delta,
+                          jnp.int8(topo.n_slots))      # no contact
+        recv = recv | gossip_pass(ys, topo.colidx, delta, rolls_off,
+                                  topo.subrolls, pull=True,
+                                  rowblk=topo.rowblk,
+                                  interpret=sim.interpret)
+
+    # Dead peers don't receive (the link is gone — gossip.py:_advance).
+    recv = recv & topo.valid_w & alive_w
+    new = recv & ~seen_w
+    seen = seen_w | new
+    # In this engine deliveries == frontier bits by construction (every
+    # first receipt enters the next frontier); both keys are kept for
+    # surface parity with sim.Simulator's metric dict.
+    deliveries = reduce(_popcount_sum(new))
+    # Coverage over honest columns of LIVE HONEST peers — the edge
+    # engine's coverage_of (sim.py:33-43).  Each ok peer contributes 32
+    # bits to popcount(ok_w), hence the >> 5 peer count.
+    ok_w = alive_w & ~state.byz_w & topo.valid_w
+    n_ok = jnp.maximum(reduce(_popcount_sum(ok_w)) >> 5, 1)
+    coverage = (reduce(_popcount_sum(seen & ok_w & sim._honest_mask))
+                .astype(jnp.float32)
+                / (n_ok.astype(jnp.float32) * sim._n_honest))
+    live = reduce(_popcount_sum(alive_w & topo.valid_w)) >> 5
+    state = AlignedState(seen_w=seen, frontier_w=new, alive_b=alive_b,
+                         byz_w=state.byz_w, strikes=strikes, key=key,
+                         round=state.round + 1)
+    return state, topo, {"coverage": coverage, "deliveries": deliveries,
+                         "frontier_size": deliveries,
+                         "live_peers": live, "evictions": n_evict}

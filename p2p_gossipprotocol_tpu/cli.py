@@ -121,13 +121,20 @@ def _run_jax_aligned(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
             n_msgs = 32 - n_junk
         n_honest = n_msgs
         n_msgs = n_msgs + n_junk
-    sim = AlignedSimulator(
-        topo=topo, n_msgs=n_msgs, mode=mode,
-        churn=ChurnConfig(rate=cfg.churn_rate),
-        byzantine_fraction=cfg.byzantine_fraction,
-        n_honest_msgs=n_honest,
-        max_strikes=cfg.max_missed_pings,
-        seed=cfg.prng_seed)
+    try:
+        sim = AlignedSimulator(
+            topo=topo, n_msgs=n_msgs, mode=mode,
+            churn=ChurnConfig(rate=cfg.churn_rate),
+            byzantine_fraction=cfg.byzantine_fraction,
+            n_honest_msgs=n_honest,
+            max_strikes=cfg.max_missed_pings,
+            seed=cfg.prng_seed)
+    except ValueError as e:
+        # e.g. max_missed_pings outside the engine's int8 strike range —
+        # values --engine edges accepts; fail cleanly like the mode/fanout
+        # checks above instead of leaking a traceback.
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
     if not args.quiet:
         print(f"[jax/aligned] simulating {n} peers, {n_msgs} messages, "
               f"mode={mode}, {sim.topo.n_slots} slots/peer, "

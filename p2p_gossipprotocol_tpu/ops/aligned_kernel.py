@@ -77,26 +77,32 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
                 interpret: bool = False) -> jax.Array:
     """One OR-accumulated D-slot pass.
 
-    ``y``       int32[R, 128]  — row-permuted packed sender words
-    ``colidx``  int8 [D, R, 128] — per-slot lane choices
+    ``y``       int32[Ry, 128] — row-permuted packed sender words.  May
+                                 cover MORE rows than the output (the
+                                 sharded engine passes the full network's
+                                 words while computing only its own row
+                                 blocks; ``rolls`` then carries the
+                                 shard's block offset)
+    ``colidx``  int8 [D, R, 128] — per-slot lane choices (R = output rows)
     ``gate``    int8 [R, 128]  — degree (push) / sampled slot (pull)
     ``rolls``   int32[D]       — per-slot block-roll offsets (scalar
                                  prefetch; drives the y index map)
     ``subrolls`` int32[D]      — per-slot sublane roll within the block
     Returns int32[R, 128]: words each peer hears this pass.
     """
-    R, C = y.shape
+    Ry, C = y.shape
     assert C == LANES, f"lane dim must be {LANES}, got {C}"
-    D = colidx.shape[0]
+    D, R, _ = colidx.shape
     blk = min(rowblk, R)
-    assert R % blk == 0
-    T = R // blk
+    assert R % blk == 0 and Ry % blk == 0
+    T = R // blk          # output (local) row blocks
+    Ty = Ry // blk        # y (possibly global) row blocks
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(T, D),
         in_specs=[
-            pl.BlockSpec((blk, C), lambda t, d, k, s: ((t + k[d]) % T, 0)),
+            pl.BlockSpec((blk, C), lambda t, d, k, s: ((t + k[d]) % Ty, 0)),
             pl.BlockSpec((1, blk, C), lambda t, d, k, s: (d, t, 0)),
             pl.BlockSpec((blk, C), lambda t, d, k, s: (t, 0)),
         ],
@@ -154,10 +160,12 @@ def liveness_pass(y_alive: jax.Array, colidx: jax.Array,
                   ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One liveness round over every slot of every peer.
 
-    ``y_alive``    int32[R, 128]   — row-permuted alive words (-1 live, 0
+    ``y_alive``    int32[Ry, 128]  — row-permuted alive words (-1 live, 0
                                      dead), same permutation as the gossip
                                      pass so slot d's neighbor-alive bit is
-                                     one dynamic_gather away
+                                     one dynamic_gather away; may cover
+                                     more rows than the output (sharded
+                                     engine — see gossip_pass)
     ``colidx``     int8 [D, R, 128] — current lane choices (mutated here)
     ``strikes``    int8 [D, R, 128] — consecutive dead observations
     ``rand_lanes`` int8 [D, R, 128] — this round's rewire candidates
@@ -165,18 +173,19 @@ def liveness_pass(y_alive: jax.Array, colidx: jax.Array,
     Returns ``(colidx', strikes', evictions int8[D, R, 128])`` where the
     eviction mask marks first crossings of the strike threshold.
     """
-    R, C = y_alive.shape
+    Ry, C = y_alive.shape
     assert C == LANES, f"lane dim must be {LANES}, got {C}"
-    D = colidx.shape[0]
+    D, R, _ = colidx.shape
     blk = min(rowblk, R)
-    assert R % blk == 0
+    assert R % blk == 0 and Ry % blk == 0
     T = R // blk
+    Ty = Ry // blk
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(T, D),
         in_specs=[
-            pl.BlockSpec((blk, C), lambda t, d, k, s: ((t + k[d]) % T, 0)),
+            pl.BlockSpec((blk, C), lambda t, d, k, s: ((t + k[d]) % Ty, 0)),
             pl.BlockSpec((1, blk, C), lambda t, d, k, s: (d, t, 0)),
             pl.BlockSpec((1, blk, C), lambda t, d, k, s: (d, t, 0)),
             pl.BlockSpec((1, blk, C), lambda t, d, k, s: (d, t, 0)),

@@ -13,8 +13,13 @@ Modules:
   mesh       — mesh construction helpers
   partition  — host-side topology partitioning into per-shard edge blocks
   sharded_sim — ShardedSimulator: the whole scan loop under shard_map
+  aligned_sharded — AlignedShardedSimulator: the scale engine (pallas
+                    kernels + bit-packed words) row-sharded over the mesh
 """
 
+from p2p_gossipprotocol_tpu.parallel.aligned_sharded import (
+    AlignedShardedSimulator,
+)
 from p2p_gossipprotocol_tpu.parallel.mesh import make_mesh
 from p2p_gossipprotocol_tpu.parallel.partition import (
     ShardedTopology,
@@ -26,6 +31,7 @@ from p2p_gossipprotocol_tpu.parallel.sharded_sim import ShardedSimulator
 
 __all__ = [
     "make_mesh",
+    "AlignedShardedSimulator",
     "ShardedTopology",
     "partition_topology",
     "shard_state",

@@ -1,0 +1,237 @@
+"""AlignedShardedSimulator — the scale engine over a device mesh.
+
+This is the multi-chip path to BASELINE config 5 (10M peers, v5e-64):
+the hardware-aligned engine (aligned.py) with its peer rows split into
+equal blocks over the mesh's ``"peers"`` axis.
+
+Communication pattern per round (all inside one ``shard_map``, compiled
+into the scan/while body):
+
+  * the global row permutation that feeds the gossip kernel becomes ONE
+    ``all_gather`` of the packed sender words followed by a local
+    permute-gather — at 32 bits per 32 rumors per peer this moves
+    n_peers/8 bytes per chip per pass (4 MB at 1M peers), the aligned
+    engine's whole-network state being ~1000x smaller than the edge
+    list it replaces;
+  * each shard then runs the SAME pallas kernels (ops/aligned_kernel.py)
+    over its own row blocks, with the per-slot block rolls offset by the
+    shard's first block index — the kernel's y index map wraps over the
+    gathered global words, so cross-shard rolls cost nothing beyond the
+    gather;
+  * metrics reduce with ``psum``.
+
+Determinism contract: every random decision (churn kills, rewire lanes,
+pull contacts) is drawn per GLOBAL row id via fold_in
+(aligned.row_uniform/row_randint), so runs are bitwise-invariant to the
+shard count AND bitwise-equal to the unsharded AlignedSimulator on the
+same topology — stronger than a statistical match, and tested as exact
+equality (tests/test_aligned_sharded.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator, AlignedState,
+                                            AlignedTopology, aligned_round)
+from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+from p2p_gossipprotocol_tpu.parallel.mesh import PEER_AXIS, make_mesh
+
+AXIS = PEER_AXIS
+
+
+def _topo_spec(topo: AlignedTopology) -> AlignedTopology:
+    """PartitionSpec tree for AlignedTopology: per-peer planes shard over
+    rows; the permutation and roll tables are replicated (the permutation
+    is int32[R] — 4 bytes/128 peers, trivially replicable).  Built with
+    ``replace`` so the flax-struct static fields (part of the treedef)
+    match the real topology's."""
+    return topo.replace(
+        perm=P(), rolls=P(), subrolls=P(),
+        colidx=P(None, AXIS, None), deg=P(AXIS, None),
+        valid_w=P(AXIS, None))
+
+
+def _state_spec(liveness: bool) -> AlignedState:
+    return AlignedState(
+        seen_w=P(AXIS, None), frontier_w=P(AXIS, None),
+        alive_b=P(AXIS, None), byz_w=P(AXIS, None),
+        strikes=P(None, AXIS, None) if liveness else None,
+        key=P(), round=P())
+
+
+@dataclass
+class AlignedShardedSimulator:
+    """Drop-in multi-chip counterpart of :class:`aligned.AlignedSimulator`
+    — same constructor surface plus ``mesh``, same SimResult/metrics."""
+
+    topo: AlignedTopology
+    mesh: object = None          # jax.sharding.Mesh; default: all devices
+    n_msgs: int = 16
+    mode: str = "push"
+    churn: ChurnConfig = None    # type: ignore[assignment]
+    byzantine_fraction: float = 0.0
+    n_honest_msgs: int | None = None
+    max_strikes: int = 3
+    seed: int = 0
+    interpret: bool | None = None
+
+    def __post_init__(self):
+        if self.mesh is None:
+            self.mesh = make_mesh()
+        self.n_shards = int(np.prod(self.mesh.devices.shape))
+        rows, blk = self.topo.rows, self.topo.rowblk
+        if rows % (self.n_shards * blk):
+            raise ValueError(
+                f"{rows} rows (rowblk {blk}) do not split over "
+                f"{self.n_shards} shards — build the overlay with "
+                f"build_aligned(..., n_shards={self.n_shards})")
+        # The unsharded engine IS the semantics: reuse its validation,
+        # init_state math and derived masks wholesale.
+        self._inner = AlignedSimulator(
+            topo=self.topo, n_msgs=self.n_msgs, mode=self.mode,
+            churn=self.churn, byzantine_fraction=self.byzantine_fraction,
+            n_honest_msgs=self.n_honest_msgs, max_strikes=self.max_strikes,
+            seed=self.seed, interpret=self.interpret)
+        self.churn = self._inner.churn
+        self.interpret = self._inner.interpret
+        self._liveness = self._inner._liveness
+        self._n_honest = self._inner._n_honest
+        self._run_cache: dict = {}
+        self._loop_cache: dict = {}
+
+    # ------------------------------------------------------------------
+    def init_state(self) -> AlignedState:
+        """Init globally (bitwise-identical for any shard count), then lay
+        out on the mesh."""
+        state = self._inner.init_state()
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s),
+            _state_spec(self._liveness),
+            is_leaf=lambda x: isinstance(x, P))
+        return jax.device_put(state, shardings)
+
+    def shard_topo(self, topo: AlignedTopology | None = None
+                   ) -> AlignedTopology:
+        topo = self.topo if topo is None else topo
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), _topo_spec(topo),
+            is_leaf=lambda x: isinstance(x, P))
+        return jax.device_put(topo, shardings)
+
+    # ------------------------------------------------------------------
+    def _step_local(self, state: AlignedState, topo: AlignedTopology
+                    ) -> tuple[AlignedState, AlignedTopology, dict]:
+        """One full round on this shard's row blocks — the SAME
+        aligned_round as the single-chip engine, with the mesh plugged in:
+        global row ids / roll offsets from the shard's position, gather =
+        all_gather (globalizes the row-permuted words the kernels read),
+        reduce = psum."""
+        rows_l = state.seen_w.shape[0]          # local rows
+        sidx = jax.lax.axis_index(AXIS)
+        grow0 = sidx * rows_l
+        grows = grow0 + jnp.arange(rows_l, dtype=jnp.int32)
+        t_off = (grow0 // topo.rowblk).astype(jnp.int32)
+        return aligned_round(
+            self._inner, state, topo, grows=grows, t_off=t_off,
+            gather=lambda x: jax.lax.all_gather(x, AXIS, tiled=True),
+            reduce=lambda x: jax.lax.psum(x, AXIS))
+
+    # ------------------------------------------------------------------
+    def _specs(self):
+        st = _state_spec(self._liveness)
+        tp = _topo_spec(self.topo)
+        metric = {k: P() for k in ("coverage", "deliveries",
+                                   "frontier_size", "live_peers",
+                                   "evictions")}
+        return st, tp, metric
+
+    def run(self, rounds: int, state: AlignedState | None = None,
+            topo: AlignedTopology | None = None):
+        """Fixed-round scan, full metric history, one shard_map around the
+        whole loop; returns the shared :class:`sim.SimResult`."""
+        import time as _time
+
+        from p2p_gossipprotocol_tpu.sim import SimResult
+
+        state = self.init_state() if state is None else state
+        topo = self.shard_topo(topo)
+        if rounds not in self._run_cache:
+            st_spec, tp_spec, metric_spec = self._specs()
+
+            def scanned(st, tp):
+                def body(carry, _):
+                    s, t = carry
+                    s, t, metrics = self._step_local(s, t)
+                    return (s, t), metrics
+                return jax.lax.scan(body, (st, tp), None, length=rounds)
+
+            self._run_cache[rounds] = jax.jit(jax.shard_map(
+                scanned, mesh=self.mesh,
+                in_specs=(st_spec, tp_spec),
+                out_specs=((st_spec, tp_spec), metric_spec),
+                check_vma=False))
+        fn = self._run_cache[rounds]
+        t0 = _time.perf_counter()
+        (state, topo), ys = fn(state, topo)
+        int(jax.device_get(state.round))    # forces completion
+        wall = _time.perf_counter() - t0
+        return SimResult(
+            state=state, topo=topo,
+            coverage=np.asarray(ys["coverage"]),
+            deliveries=np.asarray(ys["deliveries"]),
+            frontier_size=np.asarray(ys["frontier_size"]),
+            live_peers=np.asarray(ys["live_peers"]),
+            evictions=np.asarray(ys["evictions"]),
+            wall_s=wall,
+        )
+
+    def run_to_coverage(self, target: float = 0.99, max_rounds: int = 256,
+                        state: AlignedState | None = None,
+                        topo: AlignedTopology | None = None,
+                        warmup: bool = True):
+        """(state, topo, rounds_run, wall_s) — the benchmark path, same
+        contract as the unsharded engine (compile + first-execution upload
+        excluded, completion forced by a scalar device_get)."""
+        import time as _time
+
+        state = self.init_state() if state is None else state
+        topo = self.shard_topo(topo)
+        cache_key = (target, max_rounds)
+        if cache_key not in self._loop_cache:
+            st_spec, tp_spec, _ = self._specs()
+
+            def looped(st, tp):
+                def cond(carry):
+                    st, tp, cov = carry
+                    return (cov < target) & (st.round < max_rounds)
+
+                def body(carry):
+                    st, tp, _ = carry
+                    st, tp, metrics = self._step_local(st, tp)
+                    return st, tp, metrics["coverage"]
+
+                return jax.lax.while_loop(cond, body,
+                                          (st, tp, jnp.float32(0)))
+
+            fn = jax.jit(jax.shard_map(
+                looped, mesh=self.mesh,
+                in_specs=(st_spec, tp_spec),
+                out_specs=(st_spec, tp_spec, P()),
+                check_vma=False))
+            self._loop_cache[cache_key] = fn.lower(state, topo).compile()
+        fn_c = self._loop_cache[cache_key]
+        if warmup:
+            out = fn_c(state, topo)
+            jax.device_get(out[0].round)
+        t0 = _time.perf_counter()
+        st, tp, cov = fn_c(state, topo)
+        rounds_run = int(jax.device_get(st.round))
+        wall = _time.perf_counter() - t0
+        return st, tp, rounds_run, wall
