@@ -43,8 +43,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="jax mode: simulated peer count")
     p.add_argument("--rounds", type=int, default=None,
                    help="jax mode: rounds to simulate")
-    p.add_argument("--mode", choices=["push", "pull", "pushpull"],
-                   default=None, help="gossip mode override")
+    p.add_argument("--mode", choices=["push", "pull", "pushpull", "sir"],
+                   default=None,
+                   help="gossip mode override (sir = epidemic model)")
     p.add_argument("--engine", choices=["edges", "aligned"],
                    default="edges",
                    help="jax mode: exact edge-list engine, or the "
@@ -65,6 +66,12 @@ def _run_jax(cfg: NetworkConfig, args) -> int:
 
     rounds = args.rounds or cfg.rounds or 64
     with metrics_lib.profile(args.profile_dir):
+        if cfg.mode == "sir":
+            if args.engine == "aligned":
+                print("Error: --engine aligned does not run the SIR model "
+                      "(use --engine edges)", file=sys.stderr)
+                return 1
+            return _run_jax_sir(cfg, args, rounds, metrics_lib)
         if args.engine == "aligned":
             return _run_jax_aligned(cfg, args, rounds, metrics_lib)
 

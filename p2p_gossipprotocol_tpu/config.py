@@ -279,8 +279,11 @@ class NetworkConfig:
                 f"Unknown graph_backend: {self.graph_backend}")
         if self.wire_format not in ("json", "framed"):
             raise ConfigError(f"Unknown wire_format: {self.wire_format}")
-        if self.mode not in ("push", "pull", "pushpull"):
+        if self.mode not in ("push", "pull", "pushpull", "sir"):
             raise ConfigError(f"Unknown gossip mode: {self.mode}")
+        for k in ("sir_beta", "sir_gamma"):
+            if not (0.0 <= getattr(self, k) <= 1.0):
+                raise ConfigError(f"{k} must be in [0, 1]")
         if not (0.0 <= self.churn_rate < 1.0):
             raise ConfigError("churn_rate must be in [0, 1)")
         if not (0.0 <= self.byzantine_fraction < 1.0):

@@ -27,7 +27,9 @@ from p2p_gossipprotocol_tpu.liveness import (ChurnConfig, churn_step,
                                              strike_and_rewire)
 from p2p_gossipprotocol_tpu.models.byzantine import inject_byzantine
 from p2p_gossipprotocol_tpu.models.gossip import make_round_fn
-from p2p_gossipprotocol_tpu.state import GossipState, init_gossip_state
+from p2p_gossipprotocol_tpu.models.sir import sir_round
+from p2p_gossipprotocol_tpu.state import (GossipState, SIRState,
+                                          init_gossip_state, init_sir_state)
 
 
 def coverage_of(state: GossipState, n_honest: int | None = None
@@ -229,5 +231,121 @@ class Simulator:
             byzantine_fraction=cfg.byzantine_fraction,
             n_honest_msgs=n_msgs if n_junk else None,
             max_strikes=cfg.max_missed_pings,
+            seed=cfg.prng_seed,
+        )
+
+
+@dataclass
+class SIRResult:
+    """Host-side epidemic curve (the per-round S/I/R census)."""
+
+    state: SIRState
+    topo: Topology
+    susceptible: np.ndarray     # int32[rounds]
+    infected: np.ndarray        # int32[rounds]
+    recovered: np.ndarray       # int32[rounds]
+    new_infections: np.ndarray  # int32[rounds]
+    live_peers: np.ndarray      # int32[rounds]
+    wall_s: float = 0.0
+
+    @property
+    def peak_infected(self) -> int:
+        return int(self.infected.max())
+
+    @property
+    def attack_rate(self) -> float:
+        """Fraction of the population ever infected (R + I at the end)."""
+        n = self.state.n_peers
+        return float((self.infected[-1] + self.recovered[-1]) / n)
+
+    def rounds_to_extinction(self) -> int:
+        """First 1-indexed round with zero infected, or -1."""
+        hit = np.nonzero(self.infected == 0)[0]
+        return int(hit[0]) + 1 if hit.size else -1
+
+
+@dataclass
+class SIRSimulator:
+    """SIR epidemic spread over the overlay (BASELINE.json config 3:
+    BA-100k) — the same scan/metrics machinery as the gossip Simulator,
+    consuming the ``sir_beta``/``sir_gamma`` config keys end to end.
+
+    The reference has no epidemic model (its gossip IS the SI model);
+    this closes the parsed-but-ignored-key defect class the reference's
+    config system suffers from (SURVEY.md §2-C2): every ``sir_*`` key is
+    consumed here and nowhere else."""
+
+    topo: Topology
+    beta: float = 0.3
+    gamma: float = 0.1
+    n_seeds: int = 1
+    churn: ChurnConfig = field(default_factory=ChurnConfig)
+    seed: int = 0
+
+    def __post_init__(self):
+        if not 0.0 <= self.beta <= 1.0:
+            raise ValueError("sir_beta must be in [0, 1]")
+        if not 0.0 <= self.gamma <= 1.0:
+            raise ValueError("sir_gamma must be in [0, 1]")
+
+        def _scan(st, rounds):
+            def body(carry, _):
+                s, metrics = self.step(carry)
+                return s, metrics
+            return jax.lax.scan(body, st, None, length=rounds)
+
+        self._scan_jit = jax.jit(_scan, static_argnums=1)
+
+    # ------------------------------------------------------------------
+    def init_state(self) -> SIRState:
+        return init_sir_state(self.topo, jax.random.PRNGKey(self.seed),
+                              n_seeds=self.n_seeds)
+
+    # ------------------------------------------------------------------
+    def step(self, state: SIRState) -> tuple[SIRState, dict]:
+        """One round: churn → masked SIR contact/recovery → census."""
+        key, k_churn = jax.random.split(state.key)
+        alive = churn_step(k_churn, state.alive, state.round, self.churn)
+        state = state.replace(alive=alive, key=key)
+        state, n_new = sir_round(state, self.topo, beta=self.beta,
+                                 gamma=self.gamma)
+        metrics = {
+            "susceptible": jnp.sum(state.susceptible, dtype=jnp.int32),
+            "infected": jnp.sum(state.infected, dtype=jnp.int32),
+            "recovered": jnp.sum(state.recovered, dtype=jnp.int32),
+            "new_infections": n_new,
+            "live_peers": jnp.sum(state.alive, dtype=jnp.int32),
+        }
+        return state, metrics
+
+    # ------------------------------------------------------------------
+    def run(self, rounds: int, state: SIRState | None = None) -> SIRResult:
+        import time as _time
+
+        state = self.init_state() if state is None else state
+        t0 = _time.perf_counter()
+        state, ys = self._scan_jit(state, rounds)
+        jax.block_until_ready(state.compartment)
+        wall = _time.perf_counter() - t0
+        return SIRResult(
+            state=state, topo=self.topo,
+            susceptible=np.asarray(ys["susceptible"]),
+            infected=np.asarray(ys["infected"]),
+            recovered=np.asarray(ys["recovered"]),
+            new_infections=np.asarray(ys["new_infections"]),
+            live_peers=np.asarray(ys["live_peers"]),
+            wall_s=wall,
+        )
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_config(cls, cfg, n_peers: int | None = None) -> "SIRSimulator":
+        topo = graph_lib.from_config(cfg, n_peers=n_peers)
+        return cls(
+            topo=topo,
+            beta=cfg.sir_beta,
+            gamma=cfg.sir_gamma,
+            churn=(ChurnConfig(rate=cfg.churn_rate) if cfg.churn_rate
+                   else ChurnConfig()),
             seed=cfg.prng_seed,
         )
