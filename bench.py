@@ -4,12 +4,21 @@
 Headline metric (BASELINE.md north star): wall-clock seconds for 1M-peer
 push-pull gossip (power-law degree law, uniform random targets) to reach
 99% message coverage.  Baseline target is 2.0 s on TPU v5e-8;
-``vs_baseline = 2.0 / measured`` (>1 beats the target).
+``vs_baseline = 2.0 / measured`` — reported ONLY when the run actually
+matches the baseline config (1M peers on a TPU device); any other
+platform/scale reports ``vs_baseline: null`` so a 64k CPU run can never
+masquerade as beating the 1M-TPU target.
 
 Engine: the hardware-aligned pallas engine (aligned.py) — bit-packed
 message words, lane-wise dynamic-gather dissemination — which is the
 framework's scale path.  ``GOSSIP_BENCH_ENGINE=edges`` switches to the
 exact edge-list engine (sim.py) for comparison.
+
+A round must never end with no datapoint: when TPU backend init fails or
+hangs (the tunnel was down for all of rounds 1-2), the harness re-execs
+itself in a subprocess pinned to CPU at a reduced scale (default 256k
+peers) and emits a complete, honestly-labeled result line — platform and
+peer count are part of the metric name, and ``fallback: true`` marks it.
 
 Timing discipline: compilation and the remote backend's one-time
 program-upload are excluded (warm-up execution); completion is forced via
@@ -20,20 +29,29 @@ outside its dissemination path too.
 
 Env knobs: GOSSIP_BENCH_PEERS (default 1_048_576), GOSSIP_BENCH_MSGS (16),
 GOSSIP_BENCH_DEGREE (16), GOSSIP_BENCH_MODE (pushpull),
-GOSSIP_BENCH_ENGINE (aligned | edges).
+GOSSIP_BENCH_ENGINE (aligned | edges), GOSSIP_BENCH_PLATFORM (pin a
+backend), GOSSIP_BENCH_FALLBACK_PEERS (256k), GOSSIP_BENCH_NO_FALLBACK.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 BASELINE_S = 2.0  # 1M peers to 99% coverage, BASELINE.md north star
+BASELINE_PEERS = 1 << 20
+TARGET_COV = 0.99
+MAX_ROUNDS = 128
+# The real chip registers as the experimental "axon" PJRT platform, not
+# "tpu" (BENCH_r02 tail; aligned.py treats both as the TPU path).
+TPU_PLATFORMS = ("tpu", "axon")
 
 
-def _init_backend(max_tries: int = 5, probe_timeout_s: float = 90.0):
+def _init_backend(max_tries: int | None = None,
+                  probe_timeout_s: float = 90.0):
     """Initialize the JAX backend with retry/backoff (round-1 failure:
     one-shot init died with "Unable to initialize backend 'axon':
     UNAVAILABLE" and the bench emitted a raw traceback, BENCH_r01 rc=1).
@@ -48,6 +66,8 @@ def _init_backend(max_tries: int = 5, probe_timeout_s: float = 90.0):
     import jax
     import jax.extend.backend  # registers jax.extend (clear_backends)
 
+    if max_tries is None:
+        max_tries = int(os.environ.get("GOSSIP_BENCH_MAX_TRIES", "5"))
     last_err: list = [None]
     for attempt in range(max_tries):
         box: list = []
@@ -66,7 +86,8 @@ def _init_backend(max_tries: int = 5, probe_timeout_s: float = 90.0):
         if t.is_alive():
             # The probe thread is stuck inside PJRT client creation; no
             # in-process retry can help (the hung init holds the backend
-            # lock).  Bail out to the JSON error path immediately.
+            # lock).  Bail out — main() decides whether a CPU-subprocess
+            # fallback can still produce a datapoint.
             raise RuntimeError(
                 f"jax.devices() hung for {probe_timeout_s}s "
                 "(TPU tunnel unavailable?)")
@@ -80,6 +101,17 @@ def _init_backend(max_tries: int = 5, probe_timeout_s: float = 90.0):
                        f"{last_err[0]!r}")
 
 
+def _check_converged(final_cov: float, rounds: int) -> None:
+    """Success = the target was reached, full stop.  (Checking the round
+    count alone misreports a boundary-round success — run_to_coverage can
+    legitimately stop at rounds == MAX_ROUNDS with the target reached.)"""
+    if final_cov < TARGET_COV:
+        raise RuntimeError(
+            f"did not reach {TARGET_COV:.0%} coverage within {rounds} "
+            f"rounds (final coverage {final_cov:.4f} — churned scenario "
+            "failed to converge, not a valid result)")
+
+
 def _bench_aligned(n, n_msgs, degree, mode):
     """BASELINE config 4 on the scale engine: power-law overlay, 5% churn
     (one-shot kill at round 1), liveness strikes + rewire active — the
@@ -89,6 +121,7 @@ def _bench_aligned(n, n_msgs, degree, mode):
 
     from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator,
                                                 _popcount_sum,
+                                                aligned_coverage,
                                                 build_aligned)
     from p2p_gossipprotocol_tpu.liveness import ChurnConfig
 
@@ -100,12 +133,9 @@ def _bench_aligned(n, n_msgs, degree, mode):
     sim = AlignedSimulator(topo=topo, n_msgs=n_msgs, mode=mode,
                            churn=ChurnConfig(rate=churn_rate, kill_round=1),
                            max_strikes=3, seed=0)
-    state, _topo, rounds, wall = sim.run_to_coverage(target=0.99,
-                                                     max_rounds=128)
-    if rounds >= 128:
-        raise RuntimeError(
-            f"did not reach 99% coverage within {rounds} rounds "
-            "(churned scenario failed to converge — not a valid result)")
+    state, topo2, rounds, wall = sim.run_to_coverage(target=TARGET_COV,
+                                                     max_rounds=MAX_ROUNDS)
+    _check_converged(aligned_coverage(sim, state, topo2), rounds)
     total_seen = int(jax.device_get(_popcount_sum(state.seen_w)))
     n_edges = int(np.asarray(topo.deg).sum())
     return rounds, wall, total_seen, n_edges, graph_s
@@ -116,7 +146,7 @@ def _bench_edges(n, n_msgs, degree, mode):
 
     from p2p_gossipprotocol_tpu import graph
     from p2p_gossipprotocol_tpu.liveness import ChurnConfig
-    from p2p_gossipprotocol_tpu.sim import Simulator
+    from p2p_gossipprotocol_tpu.sim import Simulator, coverage_of
 
     t0 = time.perf_counter()
     topo = graph.reference_powerlaw(seed=0, n=n, max_degree=degree)
@@ -124,20 +154,63 @@ def _bench_edges(n, n_msgs, degree, mode):
     sim = Simulator(topo=topo, n_msgs=n_msgs, mode=mode,
                     churn=ChurnConfig(rate=0.05, kill_round=1),
                     max_strikes=3, rewire=True, seed=0)
-    state, _t, rounds, wall = sim.run_to_coverage(target=0.99,
-                                                  max_rounds=128)
-    if rounds >= 128:
-        raise RuntimeError(
-            f"did not reach 99% coverage within {rounds} rounds "
-            "(churned scenario failed to converge — not a valid result)")
+    state, _t, rounds, wall = sim.run_to_coverage(target=TARGET_COV,
+                                                  max_rounds=MAX_ROUNDS)
+    _check_converged(float(jax.device_get(coverage_of(state))), rounds)
     total_seen = int(jax.device_get(state.seen.sum()))
     import numpy as np
     n_edges = int(np.asarray(topo.edge_mask).sum())
     return rounds, wall, total_seen, n_edges, graph_s
 
 
+def _metric_name(n: int, mode: str, platform: str) -> str:
+    n_label = "1M" if n == 1 << 20 else str(n)
+    name = f"time_to_99pct_coverage_{n_label}_{mode}"
+    if platform not in TPU_PLATFORMS:
+        name += f"_{platform}"  # a CPU number must never look like the
+    return name                 # TPU headline (VERDICT r2 weak #8)
+
+
+def _emit_error(n, mode, engine, err, platform: str = "unknown") -> int:
+    print(json.dumps({
+        "metric": _metric_name(n, mode, platform),
+        "value": None, "unit": "s", "vs_baseline": None,
+        "error": f"{type(err).__name__}: {err}",
+        "device": None,
+        "platform": platform if platform != "unknown" else None,
+        "engine": engine, "n_peers": n,
+    }))
+    return 1
+
+
+def _cpu_fallback(n, engine) -> int:
+    """Re-exec this script pinned to CPU at reduced scale, streaming its
+    output through.  A subprocess is mandatory: the parent's backend init
+    hung/failed, and the hung PJRT client holds process-wide state no
+    in-process retry can recover."""
+    fb_peers = int(os.environ.get("GOSSIP_BENCH_FALLBACK_PEERS",
+                                  str(1 << 18)))
+    env = dict(os.environ,
+               GOSSIP_BENCH_PLATFORM="cpu",
+               JAX_PLATFORMS="cpu",
+               GOSSIP_BENCH_PEERS=str(min(n, fb_peers)),
+               GOSSIP_BENCH_NO_FALLBACK="1",
+               GOSSIP_BENCH_IS_FALLBACK="1")
+    try:
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, timeout=1800)
+    except subprocess.TimeoutExpired as e:
+        # A killed fallback must still end with a parseable line, not a
+        # traceback — "no datapoint" is the failure mode this whole path
+        # exists to eliminate.
+        return _emit_error(int(env["GOSSIP_BENCH_PEERS"]),
+                           os.environ.get("GOSSIP_BENCH_MODE", "pushpull"),
+                           engine, e, platform="cpu")
+    return proc.returncode
+
+
 def main() -> int:
-    n = int(os.environ.get("GOSSIP_BENCH_PEERS", str(1 << 20)))
+    n = int(os.environ.get("GOSSIP_BENCH_PEERS", str(BASELINE_PEERS)))
     n_msgs = int(os.environ.get("GOSSIP_BENCH_MSGS", "16"))
     degree = int(os.environ.get("GOSSIP_BENCH_DEGREE", "16"))
     mode = os.environ.get("GOSSIP_BENCH_MODE", "pushpull")
@@ -158,28 +231,34 @@ def main() -> int:
                          "(expected 'aligned' or 'edges')")
 
     try:
-        _init_backend()
+        devices = _init_backend()
+    except RuntimeError as e:
+        # TPU-first failed terminally.  Never end the round with nothing:
+        # measure on whatever hardware exists, honestly labeled.
+        if os.environ.get("GOSSIP_BENCH_NO_FALLBACK"):
+            return _emit_error(n, mode, engine, e)
+        print(f"[bench] backend init failed ({e}); falling back to a "
+              "CPU run at reduced scale", file=sys.stderr)
+        return _cpu_fallback(n, engine)
+
+    platform = devices[0].platform.lower()
+    try:
         rounds, wall, total_seen, n_edges, graph_s = fn(n, n_msgs, degree,
                                                         mode)
     except Exception as e:  # noqa: BLE001 — one JSON line, never a traceback
-        n_label = "1M" if n == 1 << 20 else str(n)
-        print(json.dumps({
-            "metric": f"time_to_99pct_coverage_{n_label}_{mode}",
-            "value": None, "unit": "s", "vs_baseline": None,
-            "error": f"{type(e).__name__}: {e}",
-            "device": None, "engine": engine, "n_peers": n,
-        }))
-        return 1
+        return _emit_error(n, mode, engine, e, platform=platform)
 
     deliveries = max(total_seen - n_msgs, 0)
     msgs_per_sec = deliveries / wall if wall > 0 else 0.0
-    device = str(jax.devices()[0]).replace(" ", "_")
-    n_label = "1M" if n == 1 << 20 else str(n)
+    device = str(devices[0]).replace(" ", "_")
+    is_baseline_cfg = (n == BASELINE_PEERS and platform in TPU_PLATFORMS
+                       and wall > 0)
     print(json.dumps({
-        "metric": f"time_to_99pct_coverage_{n_label}_{mode}",
+        "metric": _metric_name(n, mode, platform),
         "value": round(wall, 4),
         "unit": "s",
-        "vs_baseline": round(BASELINE_S / wall, 3) if wall > 0 else 0.0,
+        "vs_baseline": (round(BASELINE_S / wall, 3)
+                        if is_baseline_cfg else None),
         "n_peers": n,
         "n_msgs": n_msgs,
         "mode": mode,
@@ -190,6 +269,8 @@ def main() -> int:
         "graph_build_s": round(graph_s, 2),
         "n_edges": n_edges,
         "device": device,
+        "platform": platform,
+        "fallback": bool(os.environ.get("GOSSIP_BENCH_IS_FALLBACK")),
     }))
     return 0
 

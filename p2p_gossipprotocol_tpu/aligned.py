@@ -392,6 +392,22 @@ class AlignedSimulator:
         return st, tp, rounds_run, wall
 
 
+def aligned_coverage(sim: AlignedSimulator, state: AlignedState,
+                     topo: AlignedTopology | None = None) -> float:
+    """Host-callable honest coverage of a state — the while-loop benchmark
+    path (run_to_coverage) discards its in-loop coverage scalar, so a
+    boundary-round result (rounds == max_rounds with the target already
+    reached) needs this recheck.  Mirrors aligned_round's census
+    (ok = live, honest, valid rows; honest message columns only)."""
+    topo = sim.topo if topo is None else topo
+    alive_w = jnp.where(state.alive_b, jnp.int32(-1), jnp.int32(0))
+    ok_w = alive_w & ~state.byz_w & topo.valid_w
+    n_ok = max(int(jax.device_get(_popcount_sum(ok_w))) >> 5, 1)
+    hits = int(jax.device_get(
+        _popcount_sum(state.seen_w & ok_w & sim._honest_mask)))
+    return hits / (n_ok * sim._n_honest)
+
+
 def aligned_round(sim: AlignedSimulator, state: AlignedState,
                   topo: AlignedTopology, *, grows: jax.Array,
                   t_off: jax.Array, gather, reduce

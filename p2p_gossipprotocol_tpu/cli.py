@@ -84,7 +84,57 @@ def _run_jax(cfg: NetworkConfig, args) -> int:
                   f"{int(sim.topo.n_edges())} edges")
         res = sim.run(rounds)
     _report(res, sim, n_peers=sim.topo.n_peers, engine="edges",
-            rounds=rounds, args=args, metrics_lib=metrics_lib)
+            args=args, metrics_lib=metrics_lib)
+    return 0
+
+
+def _run_jax_sir(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
+    """Drive the SIR epidemic model (BASELINE config 3: BA-100k) through
+    the same report path as the gossip engines: per-round census lines,
+    optional JSONL, one summary JSON line with the epidemic-curve fields
+    (S/I/R, peak_infected, attack_rate)."""
+    from p2p_gossipprotocol_tpu.sim import SIRSimulator
+
+    sim = SIRSimulator.from_config(cfg, n_peers=args.n_peers)
+    if not args.quiet:
+        print(f"[jax/sir] simulating {sim.topo.n_peers} peers, "
+              f"beta={sim.beta:g}, gamma={sim.gamma:g}, "
+              f"{int(sim.topo.n_edges())} edges")
+    res = sim.run(rounds)
+    if not args.quiet:
+        for i in range(len(res.infected)):
+            print(f"round {i + 1:4d}  S={res.susceptible[i]:8d}  "
+                  f"I={res.infected[i]:8d}  R={res.recovered[i]:8d}  "
+                  f"new={res.new_infections[i]:6d}  "
+                  f"live={res.live_peers[i]:8d}")
+            if res.infected[i] == 0:
+                break
+    if args.metrics_jsonl:
+        rows = [{
+            "susceptible": int(res.susceptible[i]),
+            "infected": int(res.infected[i]),
+            "recovered": int(res.recovered[i]),
+            "new_infections": int(res.new_infections[i]),
+            "live_peers": int(res.live_peers[i]),
+        } for i in range(len(res.infected))]
+        with open(args.metrics_jsonl, "w") as fp:
+            metrics_lib.emit_jsonl(rows, fp, n_peers=sim.topo.n_peers,
+                                   mode="sir", engine="edges")
+    extinction = res.rounds_to_extinction()
+    print(json.dumps({
+        "n_peers": sim.topo.n_peers,
+        "mode": "sir",
+        "engine": "edges",
+        "rounds_run": int(len(res.infected)),
+        "final_susceptible": int(res.susceptible[-1]),
+        "final_infected": int(res.infected[-1]),
+        "final_recovered": int(res.recovered[-1]),
+        "peak_infected": res.peak_infected,
+        "attack_rate": round(res.attack_rate, 6),
+        "rounds_to_extinction": extinction,
+        "total_new_infections": int(res.new_infections.sum()),
+        "wall_s": float(res.wall_s),
+    }))
     return 0
 
 
@@ -117,17 +167,35 @@ def _run_jax_aligned(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
               f"reference/powerlaw/er overlays, not {cfg.graph!r} "
               "(use --engine edges for ba)", file=sys.stderr)
         return 1
-    topo = build_aligned(seed=cfg.prng_seed, n=n,
-                         n_slots=min(cfg.avg_degree or 16, 127),
+    # Engine ceilings (aligned.py: 32-message pack cap, int8 slot index →
+    # n_slots ≤ 127).  Never silently weaken the configured scenario
+    # (the parsed-then-quietly-altered defect class, SURVEY §2-C2):
+    # surface every clamp on stderr and in the result line.
+    clamps: list[str] = []
+    n_slots = cfg.avg_degree or 16
+    if n_slots > 127:
+        clamps.append(f"avg_degree {n_slots} -> 127 "
+                      "(aligned engine slot index is int8)")
+        n_slots = 127
+    topo = build_aligned(seed=cfg.prng_seed, n=n, n_slots=n_slots,
                          degree_law=law, powerlaw_alpha=cfg.powerlaw_alpha)
-    n_msgs = min(cfg.n_messages or cfg.max_message_count, 32)
+    n_msgs = cfg.n_messages or cfg.max_message_count
+    if n_msgs > 32:
+        clamps.append(f"n_messages {n_msgs} -> 32 "
+                      "(aligned engine packs messages into one int32 word)")
+        n_msgs = 32
     n_honest = None
     if cfg.byzantine_fraction > 0.0:
         n_junk = max(1, n_msgs // 4)
         if n_msgs + n_junk > 32:
+            clamps.append(f"n_messages {n_msgs} -> {32 - n_junk} "
+                          f"(32-word cap shared with {n_junk} byzantine "
+                          "junk columns)")
             n_msgs = 32 - n_junk
         n_honest = n_msgs
         n_msgs = n_msgs + n_junk
+    for c in clamps:
+        print(f"Warning: --engine aligned clamped {c}", file=sys.stderr)
     try:
         sim = AlignedSimulator(
             topo=topo, n_msgs=n_msgs, mode=mode,
@@ -148,14 +216,17 @@ def _run_jax_aligned(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
               f"churn={cfg.churn_rate:g}, "
               f"byzantine={cfg.byzantine_fraction:g}")
     res = sim.run(rounds)
-    _report(res, sim, n_peers=n, engine="aligned", rounds=rounds,
-            args=args, metrics_lib=metrics_lib)
+    _report(res, sim, n_peers=n, engine="aligned",
+            args=args, metrics_lib=metrics_lib, clamps=clamps)
     return 0
 
 
-def _report(res, sim, *, n_peers, engine, rounds, args, metrics_lib):
+def _report(res, sim, *, n_peers, engine, args, metrics_lib, clamps=None):
     """Shared per-round printout + JSONL + summary line for both engines
-    (they return the same SimResult)."""
+    (they return the same SimResult).  ``rounds_run`` is the number of
+    rounds the scan actually executed (fixed-length), and the summary's
+    ``rounds_to_<target>`` gives convergence; ``clamped`` records any
+    configured value the engine had to reduce."""
     if not args.quiet:
         for i in range(len(res.coverage)):
             print(f"round {i + 1:4d}  coverage={res.coverage[i]:.4f}  "
@@ -169,14 +240,19 @@ def _report(res, sim, *, n_peers, engine, rounds, args, metrics_lib):
             metrics_lib.emit_jsonl(metrics_lib.rows_from_result(res), fp,
                                    n_peers=n_peers, mode=sim.mode,
                                    engine=engine)
-    print(json.dumps({
+    summary = metrics_lib.summarize(res, args.target_coverage)
+    summary.pop("rounds", None)   # identical to rounds_run below
+    out = {
         "n_peers": n_peers,
         "n_msgs": sim.n_msgs,
         "mode": sim.mode,
         "engine": engine,
-        "rounds_run": rounds,
-        **metrics_lib.summarize(res, args.target_coverage),
-    }))
+        "rounds_run": int(len(res.coverage)),
+        **summary,
+    }
+    if clamps:
+        out["clamped"] = clamps
+    print(json.dumps(out))
 
 
 def _run_socket(cfg: NetworkConfig, args) -> int:

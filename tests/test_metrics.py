@@ -52,6 +52,59 @@ def test_cli_metrics_jsonl(tmp_path):
     assert rows[-1]["coverage"] == result["final_coverage"]
 
 
+def test_cli_aligned_clamps_are_surfaced(tmp_path):
+    """Engine ceilings (32-msg pack, 127-slot int8) must be announced, not
+    silently applied — the never-silently-weaken rule (SURVEY §2-C2)."""
+    cfg = tmp_path / "net.txt"
+    cfg.write_text("10.0.0.1:8000\n"
+                   "graph=er\nn_peers=512\navg_degree=200\nmode=push\n"
+                   "n_messages=40\nprng_seed=1\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "p2p_gossipprotocol_tpu.cli", str(cfg),
+         "--backend", "jax", "--engine", "aligned", "--rounds", "8",
+         "--quiet"],
+        capture_output=True, text=True, timeout=300,
+        env={"PYTHONPATH": "/root/repo", "JAX_PLATFORMS": "cpu",
+             "PATH": "/usr/bin:/bin:/usr/local/bin"},
+        cwd="/root/repo")
+    assert proc.returncode == 0, proc.stderr
+    assert "clamped avg_degree 200 -> 127" in proc.stderr
+    assert "clamped n_messages 40 -> 32" in proc.stderr
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert len(result["clamped"]) == 2
+    assert result["n_msgs"] == 32
+
+
+def test_cli_sir_mode(tmp_path):
+    """BASELINE config 3 (SIR epidemic) must run end to end from one
+    command — the round-2 regression was a NameError on this exact path."""
+    cfg = tmp_path / "net.txt"
+    cfg.write_text("10.0.0.1:8000\n"
+                   "graph=ba\nn_peers=2000\navg_degree=8\nmode=sir\n"
+                   "sir_beta=0.4\nsir_gamma=0.1\nprng_seed=4\n")
+    out = tmp_path / "sir.jsonl"
+    proc = subprocess.run(
+        [sys.executable, "-m", "p2p_gossipprotocol_tpu.cli", str(cfg),
+         "--backend", "jax", "--rounds", "25", "--quiet",
+         "--metrics-jsonl", str(out)],
+        capture_output=True, text=True, timeout=300,
+        env={"PYTHONPATH": "/root/repo", "JAX_PLATFORMS": "cpu",
+             "PATH": "/usr/bin:/bin:/usr/local/bin"},
+        cwd="/root/repo")
+    assert proc.returncode == 0, proc.stderr
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["mode"] == "sir"
+    assert result["n_peers"] == 2000
+    assert result["rounds_run"] == 25
+    assert result["peak_infected"] > 10
+    assert 0.0 < result["attack_rate"] <= 1.0
+    assert (result["final_susceptible"] + result["final_infected"]
+            + result["final_recovered"]) == 2000
+    rows = [json.loads(line) for line in out.read_text().splitlines()]
+    assert len(rows) == 25
+    assert rows[0]["mode"] == "sir"
+
+
 def test_cli_aligned_engine(tmp_path):
     proc = subprocess.run(
         [sys.executable, "-m", "p2p_gossipprotocol_tpu.cli",

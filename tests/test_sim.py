@@ -87,6 +87,44 @@ def test_sir_no_spread_when_beta_zero():
     assert int(np.asarray(st.infected).sum()) == 3
 
 
+def test_sir_simulator_conservation_and_churn_masking():
+    """SIRSimulator (the class, not just sir_round): at 10k peers every
+    round's census conserves S+I+R == n, and churn masks transmission —
+    heavy churn yields a strictly smaller attack rate than no churn on
+    the same overlay/seed."""
+    from p2p_gossipprotocol_tpu.sim import SIRSimulator
+
+    topo = G.barabasi_albert(11, 10_000, m=4)
+    sim = SIRSimulator(topo=topo, beta=0.3, gamma=0.1, n_seeds=10,
+                       churn=ChurnConfig(rate=0.02), seed=2)
+    res = sim.run(40)
+    census = res.susceptible + res.infected + res.recovered
+    assert (census == topo.n_peers).all()          # compartments exhaustive
+    assert res.live_peers[-1] < topo.n_peers        # churn actually killed
+    assert res.peak_infected > 10                   # spread beyond seeds
+    assert 0.0 < res.attack_rate <= 1.0
+
+    calm = SIRSimulator(topo=topo, beta=0.3, gamma=0.1, n_seeds=10,
+                        seed=2).run(40)
+    stormy = SIRSimulator(topo=topo, beta=0.3, gamma=0.1, n_seeds=10,
+                          churn=ChurnConfig(rate=0.15), seed=2).run(40)
+    assert stormy.attack_rate < calm.attack_rate    # masking suppresses spread
+
+
+def test_sir_simulator_from_config(tmp_path):
+    p = tmp_path / "net.txt"
+    p.write_text("10.0.0.1:8000\n"
+                 "graph=ba\nn_peers=2000\navg_degree=8\nmode=sir\n"
+                 "sir_beta=0.4\nsir_gamma=0.1\nprng_seed=4\n")
+    from p2p_gossipprotocol_tpu.config import NetworkConfig
+    from p2p_gossipprotocol_tpu.sim import SIRSimulator
+
+    sim = SIRSimulator.from_config(NetworkConfig(str(p)))
+    assert sim.beta == pytest.approx(0.4)
+    res = sim.run(30)
+    assert res.attack_rate > 0.5                    # epidemic took off
+
+
 def test_byzantine_config_recovers_honest_coverage(tmp_path):
     p = tmp_path / "net.txt"
     p.write_text("10.0.0.1:8000\n"
