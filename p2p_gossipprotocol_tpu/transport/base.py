@@ -1,22 +1,54 @@
 """Abstract transport interface.
 
 The reference hard-wires BSD sockets into the gossip logic
-(peer.cpp:30-58, 161-173); here delivery is pluggable — the same gossip
-semantics run over TCP (interop) or over the TPU adjacency (simulation).
+(peer.cpp:30-58, 161-173); here ALL inter-peer data movement in the
+simulation engine goes through this seam.  The three primitives cover
+every movement the round kernels perform:
+
+* :meth:`deliver`  — flood a transmission set over the live edge set
+  (the reference's ``broadcastMessage`` loop, peer.cpp:310-312);
+* :meth:`fetch`    — each peer reads one sampled neighbor's seen-set
+  (the anti-entropy pull contact);
+* :meth:`push_to`  — each peer writes its payload to one sampled contact
+  (the push half of a push-pull exchange).
+
+``models.gossip.make_round_fn`` takes a Transport and the Simulator
+threads its own through, so swapping the implementation (see
+tests/test_transport.py's dense-matmul transport) changes HOW bits move
+without touching gossip semantics.
 """
 
 from __future__ import annotations
 
 import abc
 
+import jax
+
 
 class Transport(abc.ABC):
-    """Delivers gossip payloads between peers."""
+    """Moves gossip payloads between peers; implementations must be pure
+    (jit-traceable) in the array arguments."""
 
-    @abc.abstractmethod
     def start(self) -> None:
         """Bring the transport up (bind/listen, or allocate device state)."""
 
-    @abc.abstractmethod
     def stop(self) -> None:
         """Tear the transport down."""
+
+    @abc.abstractmethod
+    def deliver(self, sending: jax.Array, topo,
+                edge_gate: jax.Array | None = None) -> jax.Array:
+        """bool[n, m] transmissions → bool[n, m] receptions over the
+        edge set (optionally gated per-edge)."""
+
+    @abc.abstractmethod
+    def fetch(self, payload: jax.Array, nbr: jax.Array,
+              ok: jax.Array) -> jax.Array:
+        """Each peer i reads ``payload[nbr[i]]`` where ``ok[i]`` (bool[n])
+        gates the contact; returns bool[n, m] of fetched bits."""
+
+    @abc.abstractmethod
+    def push_to(self, recv: jax.Array, payload: jax.Array,
+                nbr: jax.Array, ok: jax.Array) -> jax.Array:
+        """Each peer i with ``ok[i]`` ORs ``payload[i]`` into
+        ``recv[nbr[i]]``; returns the updated recv."""
