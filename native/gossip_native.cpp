@@ -237,14 +237,21 @@ int64_t gn_frame_encode(const uint8_t* payload, uint64_t len, uint8_t* out,
 // int64 slots).  Trailing partial frames are simply not reported — the
 // caller keeps those bytes buffered, which is the fix for the
 // reference's fragmentation bug (peer.cpp:188-194).
+//
+// A length prefix above max_len is a protocol violation (corrupt or
+// hostile peer): returns -1 so the caller can drop the connection
+// instead of buffering up to 4 GiB waiting for a frame that will never
+// complete.  The violating prefix is detected the moment its 4 bytes
+// arrive — no payload bytes are ever accumulated for it.
 int64_t gn_frame_scan(const uint8_t* buf, uint64_t len, int64_t* spans,
-                      int64_t max_frames) {
+                      int64_t max_frames, uint64_t max_len) {
   int64_t count = 0;
   uint64_t pos = 0;
   while (pos + 4 <= len && count < max_frames) {
     uint64_t flen = (uint64_t(buf[pos]) << 24) |
                     (uint64_t(buf[pos + 1]) << 16) |
                     (uint64_t(buf[pos + 2]) << 8) | uint64_t(buf[pos + 3]);
+    if (flen > max_len) return -1;
     if (pos + 4 + flen > len) break;
     spans[2 * count] = int64_t(pos + 4);
     spans[2 * count + 1] = int64_t(flen);

@@ -29,11 +29,17 @@ import jax.numpy as jnp
 
 from p2p_gossipprotocol_tpu.graph import Topology
 from p2p_gossipprotocol_tpu.ops.propagate import (
-    edge_or_scatter,
     sample_fanout_gate,
     sample_out_neighbor,
 )
 from p2p_gossipprotocol_tpu.state import GossipState
+from p2p_gossipprotocol_tpu.transport.base import Transport
+from p2p_gossipprotocol_tpu.transport.jax_transport import JaxTransport
+
+# All data movement below goes through a Transport (SURVEY.md §1's one
+# new seam); the default is the HBM OR-scatter.  Stateless, so a single
+# shared instance is fine.
+_DEFAULT_TRANSPORT = JaxTransport()
 
 
 def _advance(state: GossipState, recv: jax.Array, key: jax.Array
@@ -47,18 +53,20 @@ def _advance(state: GossipState, recv: jax.Array, key: jax.Array
     return state, deliveries
 
 
-def push_round(state: GossipState, topo: Topology, fanout: int = 0
+def push_round(state: GossipState, topo: Topology, fanout: int = 0,
+               transport: Transport = _DEFAULT_TRANSPORT
                ) -> tuple[GossipState, jax.Array]:
     """Flood push (fanout=0, the reference's broadcast) or bounded-fanout
     rumor mongering (fanout>0)."""
     key, k_fan = jax.random.split(state.key)
     send = state.frontier & state.alive[:, None] & ~state.byzantine[:, None]
     gate = sample_fanout_gate(k_fan, topo, fanout) if fanout > 0 else None
-    recv = edge_or_scatter(send, topo, gate)
+    recv = transport.deliver(send, topo, gate)
     return _advance(state, recv, key)
 
 
-def pull_round(state: GossipState, topo: Topology
+def pull_round(state: GossipState, topo: Topology,
+               transport: Transport = _DEFAULT_TRANSPORT
                ) -> tuple[GossipState, jax.Array]:
     """Anti-entropy pull: every live peer contacts one random neighbor and
     copies its seen-set (the neighbor's full ``messageList``)."""
@@ -66,37 +74,43 @@ def pull_round(state: GossipState, topo: Topology
     nbr, valid = sample_out_neighbor(k_nbr, topo)
     ok = (valid & state.alive & state.alive[nbr]
           & ~state.byzantine[nbr])          # byz peers refuse to serve pulls
-    recv = state.seen[nbr] & ok[:, None]
+    recv = transport.fetch(state.seen, nbr, ok)
     return _advance(state, recv, key)
 
 
-def pushpull_round(state: GossipState, topo: Topology, fanout: int = 0
+def pushpull_round(state: GossipState, topo: Topology, fanout: int = 0,
+                   transport: Transport = _DEFAULT_TRANSPORT
                    ) -> tuple[GossipState, jax.Array]:
     """Push-pull: one contact per peer serves both directions (the classic
     anti-entropy exchange), plus the flood/fanout push of novel rumors."""
     key, k_fan, k_nbr = jax.random.split(state.key, 3)
     send = state.frontier & state.alive[:, None] & ~state.byzantine[:, None]
     gate = sample_fanout_gate(k_fan, topo, fanout) if fanout > 0 else None
-    recv = edge_or_scatter(send, topo, gate)
+    recv = transport.deliver(send, topo, gate)
 
     nbr, valid = sample_out_neighbor(k_nbr, topo)
     contact = valid & state.alive & state.alive[nbr]
     # pull: i copies nbr(i)'s seen-set (unless nbr is byzantine)
-    recv = recv | (state.seen[nbr] & (contact & ~state.byzantine[nbr])[:, None])
+    recv = recv | transport.fetch(state.seen, nbr,
+                                  contact & ~state.byzantine[nbr])
     # push half of the exchange: nbr(i) receives i's seen-set (unless i is
     # byzantine) — scatter-OR over the sampled contacts.
-    give = state.seen & (contact & ~state.byzantine)[:, None]
-    recv = recv.at[nbr].max(give, mode="drop")
+    recv = transport.push_to(recv, state.seen, nbr,
+                             contact & ~state.byzantine)
     return _advance(state, recv, key)
 
 
-def make_round_fn(mode: str, fanout: int = 0):
+def make_round_fn(mode: str, fanout: int = 0,
+                  transport: Transport | None = None):
     """Round function for a config ``mode`` (push | pull | pushpull),
-    signature ``(state, topo) -> (state', deliveries)``."""
+    signature ``(state, topo) -> (state', deliveries)``.  ``transport``
+    selects HOW bits move (default: the HBM OR-scatter) without touching
+    gossip semantics."""
+    transport = _DEFAULT_TRANSPORT if transport is None else transport
     if mode == "push":
-        return partial(push_round, fanout=fanout)
+        return partial(push_round, fanout=fanout, transport=transport)
     if mode == "pull":
-        return pull_round
+        return partial(pull_round, transport=transport)
     if mode == "pushpull":
-        return partial(pushpull_round, fanout=fanout)
+        return partial(pushpull_round, fanout=fanout, transport=transport)
     raise ValueError(f"Unknown gossip mode: {mode}")

@@ -54,7 +54,7 @@ def _load():
                                     ctypes.c_char_p, ctypes.c_uint64]
     lib.gn_frame_encode.restype = ctypes.c_int64
     lib.gn_frame_scan.argtypes = [ctypes.c_char_p, ctypes.c_uint64, i64p,
-                                  ctypes.c_int64]
+                                  ctypes.c_int64, ctypes.c_uint64]
     lib.gn_frame_scan.restype = ctypes.c_int64
     _LIB = lib
     return lib
@@ -121,9 +121,25 @@ def ba_edges(seed: int, n: int, m: int = 4):
 
 
 # ---------------------------------------------------------------------------
-def frame_encode(payload: bytes) -> bytes:
+# A length prefix above this is a protocol violation: the prefix is
+# 4 bytes, so a corrupt/hostile peer could otherwise declare up to 4 GiB
+# and stall the stream while the receive buffer grows without limit
+# (round-2 advisor finding).  16 MiB is ~4000× the reference's largest
+# possible message (4 KB recv buffer, peer.cpp:188).
+MAX_FRAME_LEN = 16 * 1024 * 1024
+
+
+class FrameTooLargeError(ValueError):
+    """A frame length prefix exceeded MAX_FRAME_LEN — the caller should
+    drop the connection (the stream can never resynchronize)."""
+
+
+def frame_encode(payload: bytes, max_len: int = MAX_FRAME_LEN) -> bytes:
     """4-byte big-endian length prefix + payload (the framing the
     reference's unframed TCP protocol lacks, SURVEY.md §2-C7)."""
+    if len(payload) > max_len:
+        raise FrameTooLargeError(
+            f"payload of {len(payload)} bytes exceeds frame cap {max_len}")
     lib = _load()
     if lib is None:
         return len(payload).to_bytes(4, "big") + payload
@@ -135,22 +151,32 @@ def frame_encode(payload: bytes) -> bytes:
     return out.raw[:n]
 
 
-def frame_scan(buf: bytes, max_frames: int = 1024):
+def frame_scan(buf: bytes, max_frames: int = 1024,
+               max_len: int = MAX_FRAME_LEN):
     """Complete frames in ``buf`` as (payload, end_offset) with the
-    trailing partial bytes left to the caller's buffer."""
+    trailing partial bytes left to the caller's buffer.  Raises
+    :class:`FrameTooLargeError` the moment any length prefix exceeds
+    ``max_len`` — before buffering a single payload byte for it."""
     lib = _load()
     if lib is None:
         frames = []
         pos = 0
         while pos + 4 <= len(buf) and len(frames) < max_frames:
             flen = int.from_bytes(buf[pos:pos + 4], "big")
+            if flen > max_len:
+                raise FrameTooLargeError(
+                    f"frame prefix declares {flen} bytes (cap {max_len})")
             if pos + 4 + flen > len(buf):
                 break
             frames.append(buf[pos + 4:pos + 4 + flen])
             pos += 4 + flen
         return frames, pos
     spans = np.empty(2 * max_frames, np.int64)
-    count = int(lib.gn_frame_scan(buf, len(buf), spans, max_frames))
+    count = int(lib.gn_frame_scan(buf, len(buf), spans, max_frames,
+                                  max_len))
+    if count < 0:
+        raise FrameTooLargeError(
+            f"frame prefix exceeds cap {max_len} bytes")
     frames = []
     pos = 0
     for i in range(count):

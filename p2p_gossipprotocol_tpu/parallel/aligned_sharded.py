@@ -153,9 +153,14 @@ class AlignedShardedSimulator:
         return st, tp, metric
 
     def run(self, rounds: int, state: AlignedState | None = None,
-            topo: AlignedTopology | None = None):
+            topo: AlignedTopology | None = None, warmup: bool = False):
         """Fixed-round scan, full metric history, one shard_map around the
-        whole loop; returns the shared :class:`sim.SimResult`."""
+        whole loop; returns the shared :class:`sim.SimResult`.
+
+        With ``warmup`` the compiled program executes once untimed first
+        (same flag as ``AlignedSimulator.run`` and both run_to_coverage
+        paths — round-2 advisor benchmark-parity finding), so ``wall_s``
+        excludes compile + one-time program upload."""
         import time as _time
 
         from p2p_gossipprotocol_tpu.sim import SimResult
@@ -178,6 +183,9 @@ class AlignedShardedSimulator:
                 out_specs=((st_spec, tp_spec), metric_spec),
                 check_vma=False))
         fn = self._run_cache[rounds]
+        if warmup:
+            (w_state, _), _ = fn(state, topo)
+            int(jax.device_get(w_state.round))
         t0 = _time.perf_counter()
         (state, topo), ys = fn(state, topo)
         int(jax.device_get(state.round))    # forces completion

@@ -44,6 +44,12 @@ from p2p_gossipprotocol_tpu.state import GossipState, init_gossip_state
 
 AXIS = PEER_AXIS
 
+# psum_scatter accumulates per-shard 0/1 receive indicators: the sum can
+# reach n_shards, so the dtype must hold the largest mesh this module
+# targets.  int8 wrapped (silently dropping deliveries) at ≥128 shards —
+# round-2 advisor finding; ≥32-bit is asserted by tests/test_sharded.py.
+COUNT_DTYPE = jnp.int32
+
 
 def _peer_uniform(key: jax.Array, n_pad: int, lo: jax.Array,
                   block: int) -> jax.Array:
@@ -194,7 +200,7 @@ class ShardedSimulator:
                 partial = partial.at[nbr].max(give, mode="drop")
 
         if do_push or self.mode == "pushpull":
-            counts = jax.lax.psum_scatter(partial.astype(jnp.int8), AXIS,
+            counts = jax.lax.psum_scatter(partial.astype(COUNT_DTYPE), AXIS,
                                           scatter_dimension=0, tiled=True)
             recv = counts > 0
         else:

@@ -89,9 +89,11 @@ class Simulator:
     max_strikes: int = 3
     rewire: bool = True
     seed: int = 0
+    transport: object | None = None   # Transport; None → JaxTransport
 
     def __post_init__(self):
-        self._round_fn = make_round_fn(self.mode, self.fanout)
+        self._round_fn = make_round_fn(self.mode, self.fanout,
+                                       transport=self.transport)
         self._n_honest = (self.n_honest_msgs
                           if self.n_honest_msgs is not None else self.n_msgs)
 

@@ -1,19 +1,25 @@
 """Transport layer: the seam SURVEY.md §1 prescribes between gossip
 semantics and message delivery.
 
-* :class:`JaxTransport` — delivery as masked OR-scatter over the HBM
-  adjacency (the TPU path; what Simulator uses).
-* :class:`SocketTransport` + :class:`JsonStream` — real TCP speaking the
-  reference's unframed-JSON wire format for small-n interop.
+* :class:`Transport` — the simulation engine's array-movement contract
+  (deliver / fetch / push_to); every round kernel in models/gossip.py is
+  written against it.
+* :class:`JaxTransport` — the default implementation: masked gathers and
+  OR-scatters over the HBM adjacency (the TPU path; what Simulator uses).
+* :class:`SocketTransport` + :class:`JsonStream`/:class:`FramedStream` —
+  real TCP speaking the reference's unframed-JSON wire format for
+  small-n interop (peer.py/seed.py plumbing, outside the array seam).
 """
 
 from p2p_gossipprotocol_tpu.transport.base import Transport
 from p2p_gossipprotocol_tpu.transport.jax_transport import JaxTransport
 from p2p_gossipprotocol_tpu.transport.socket_transport import (
+    FramedStream,
     JsonStream,
     SocketTransport,
+    send_framed,
     send_json,
 )
 
 __all__ = ["Transport", "JaxTransport", "SocketTransport", "JsonStream",
-           "send_json"]
+           "FramedStream", "send_json", "send_framed"]

@@ -1,34 +1,45 @@
-"""JaxTransport: message delivery as a masked OR-scatter over the
-fixed-capacity adjacency in HBM — the TPU-native replacement for the
-reference's per-socket ``send``/``recv`` (SURVEY.md §2 native-equivalents
-table, row 1).
+"""JaxTransport: inter-peer data movement as masked gathers/OR-scatters
+over the fixed-capacity adjacency in HBM — the TPU-native replacement for
+the reference's per-socket ``send``/``recv`` (SURVEY.md §2
+native-equivalents table, row 1).
 
 One ``deliver`` call moves every in-flight message across every live edge
-simultaneously; there are no connections, buffers, or partial reads to
-manage.  The Simulator composes this with dedup/liveness; the class exists
-so transports stay swappable at the API seam.
+simultaneously; one ``fetch``/``push_to`` pair is a whole network's worth
+of anti-entropy contacts.  There are no connections, buffers, or partial
+reads to manage.  The round kernels in ``models/gossip.py`` are written
+against the abstract :class:`Transport`; this is the implementation the
+Simulator uses by default (see tests/test_transport.py for a swapped-in
+dense-matmul transport proving the seam).
 """
 
 from __future__ import annotations
 
 import jax
 
-from p2p_gossipprotocol_tpu.graph import Topology
 from p2p_gossipprotocol_tpu.ops.propagate import edge_or_scatter
 from p2p_gossipprotocol_tpu.transport.base import Transport
 
 
 class JaxTransport(Transport):
-    def __init__(self, topo: Topology):
-        self.topo = topo
+    """Stateless: the topology rides in as an argument, so one instance
+    serves any graph and the methods stay jit-traceable."""
 
-    def start(self) -> None:  # nothing to bring up: state lives in HBM
-        pass
-
-    def stop(self) -> None:
-        pass
-
-    def deliver(self, sending: jax.Array,
+    def deliver(self, sending: jax.Array, topo,
                 edge_gate: jax.Array | None = None) -> jax.Array:
-        """bool[n, m] of transmissions → bool[n, m] of receptions."""
-        return edge_or_scatter(sending, self.topo, edge_gate)
+        """bool[n, m] of transmissions → bool[n, m] of receptions: the
+        vectorization of the reference's broadcast loop
+        (peer.cpp:310-312)."""
+        return edge_or_scatter(sending, topo, edge_gate)
+
+    def fetch(self, payload: jax.Array, nbr: jax.Array,
+              ok: jax.Array) -> jax.Array:
+        """Each peer i reads ``payload[nbr[i]]`` where ``ok[i]`` — the
+        anti-entropy pull contact (one gather)."""
+        return payload[nbr] & ok[:, None]
+
+    def push_to(self, recv: jax.Array, payload: jax.Array,
+                nbr: jax.Array, ok: jax.Array) -> jax.Array:
+        """Each peer i with ``ok[i]`` ORs ``payload[i]`` into
+        ``recv[nbr[i]]`` — the push half of a push-pull exchange (one
+        OR-scatter; scatter-max == OR over {0,1})."""
+        return recv.at[nbr].max(payload & ok[:, None], mode="drop")

@@ -16,8 +16,6 @@ import codecs
 import json
 import socket
 
-from p2p_gossipprotocol_tpu.transport.base import Transport
-
 RECV_SIZE = 4096  # reference buffer size (peer.cpp:188)
 _DECODER = json.JSONDecoder()
 
@@ -80,7 +78,12 @@ class FramedStream:
     """Length-framed counterpart of :class:`JsonStream` (same
     ``recv_objects`` interface): complete frames are split off by the
     native codec; partial trailing bytes stay buffered, so TCP
-    fragmentation/coalescing can never corrupt a document."""
+    fragmentation/coalescing can never corrupt a document.
+
+    Frame lengths are bounded by ``native.MAX_FRAME_LEN`` (16 MiB): a
+    corrupt or hostile prefix — up to 4 GiB is expressible in 4 bytes —
+    closes the connection immediately instead of stalling the stream
+    while the buffer grows without limit (round-2 advisor finding)."""
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
@@ -96,7 +99,21 @@ class FramedStream:
         if not chunk:
             return None
         self._buf += chunk
-        frames, consumed = native.frame_scan(self._buf)
+        try:
+            frames, consumed = native.frame_scan(self._buf)
+        except native.FrameTooLargeError:
+            # Unrecoverable: the stream can never resynchronize past a
+            # bogus length.  Drop the connection, surface EOF.
+            self._buf = b""
+            try:
+                self.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            return None
         self._buf = self._buf[consumed:]
         return [json.loads(f) for f in frames]
 
@@ -107,11 +124,16 @@ WIRE_FORMATS = {
 }
 
 
-class SocketTransport(Transport):
+class SocketTransport:
     """Listening socket + connection bookkeeping for a socket-mode node.
 
     Mirrors the reference's listen setup: SO_REUSEADDR, backlog 10
-    (peer.cpp:30-58, seed.cpp:27-55).
+    (peer.cpp:30-58, seed.cpp:27-55).  Deliberately NOT a
+    :class:`~p2p_gossipprotocol_tpu.transport.base.Transport`: that seam
+    is the simulation engine's array-movement contract (jit-traceable
+    bulk primitives); this class is per-connection plumbing for the
+    interop runtime in peer.py/seed.py, which moves one JSON document at
+    a time over real TCP.
     """
 
     BACKLOG = 10

@@ -51,6 +51,13 @@ class Peer:
             self.node = None
             self._sim = Simulator.from_config(cfg)
             self._running = False
+            self._stop_event = threading.Event()
+            self.rounds_completed = 0   # chunks landed so far (jax)
+
+    #: rounds per jitted scan call on the jax backend — the stop() check
+    #: granularity.  Small enough that stop() returns promptly, large
+    #: enough that the per-call dispatch overhead stays negligible.
+    JAX_ROUND_CHUNK = 8
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> bool:
@@ -58,10 +65,43 @@ class Peer:
             return self.node.start()
         rounds = self.config.rounds or 64
 
+        # The scan runs in JAX_ROUND_CHUNK-round chunks with the stop flag
+        # checked between chunks, so stop() actually interrupts the run
+        # (a single monolithic scan is uninterruptible — the reference's
+        # stop() really stops its threads, wrapper.cpp:27-30, and ours
+        # must too).  Chunks of one fixed size share one compiled program.
         def _run():
-            self._result = self._sim.run(rounds)
+            import numpy as np
+
+            from p2p_gossipprotocol_tpu.sim import SimResult
+
+            state, topo, parts, wall, done = None, None, [], 0.0, 0
+            while done < rounds and not self._stop_event.is_set():
+                step = min(self.JAX_ROUND_CHUNK, rounds - done)
+                r = self._sim.run(step, state=state, topo=topo)
+                parts.append(r)
+                state, topo = r.state, r.topo
+                wall += r.wall_s
+                done += step
+                self.rounds_completed = done
+            if parts:
+                self._result = SimResult(
+                    state=state, topo=topo,
+                    coverage=np.concatenate([p.coverage for p in parts]),
+                    deliveries=np.concatenate(
+                        [p.deliveries for p in parts]),
+                    frontier_size=np.concatenate(
+                        [p.frontier_size for p in parts]),
+                    live_peers=np.concatenate(
+                        [p.live_peers for p in parts]),
+                    evictions=np.concatenate(
+                        [p.evictions for p in parts]),
+                    wall_s=wall,
+                )
             self._running = False
 
+        self._stop_event.clear()
+        self.rounds_completed = 0
         self._running = True
         self._thread = threading.Thread(target=_run, daemon=True)
         self._thread.start()
@@ -70,8 +110,14 @@ class Peer:
     def stop(self) -> None:
         if self._backend == "socket":
             self.node.stop()
-        else:
-            self._running = False  # scan finishes; result kept if complete
+            return
+        # Interrupt at the next chunk boundary and wait for the worker to
+        # drain, so is_running() is False when stop() returns — the
+        # partial result (all completed chunks) is kept.
+        self._stop_event.set()
+        if self._thread is not None:
+            self._thread.join()
+        self._running = False
 
     def is_running(self) -> bool:
         if self._backend == "socket":

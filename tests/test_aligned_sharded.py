@@ -89,3 +89,16 @@ def test_shard_mismatch_raises(devices8):
     # rows=8 with rowblk=8 → 1 block total, cannot split over 8 shards
     with pytest.raises(ValueError, match="n_shards"):
         AlignedShardedSimulator(topo=topo, mesh=make_mesh(8), n_msgs=4)
+
+
+def test_run_warmup_parity(devices8, topo8):
+    """run(warmup=True) must exist (benchmark parity with the unsharded
+    engine, round-2 advisor finding) and change only the timing, never
+    the results."""
+    sim = AlignedShardedSimulator(topo=topo8, mesh=make_mesh(8), **KW)
+    cold = sim.run(4)
+    warm = sim.run(4, warmup=True)
+    np.testing.assert_array_equal(np.asarray(cold.state.seen_w),
+                                  np.asarray(warm.state.seen_w))
+    np.testing.assert_array_equal(cold.coverage, warm.coverage)
+    assert warm.wall_s > 0

@@ -79,3 +79,48 @@ class TestNativeBuilders:
             4096, np.concatenate([src, dst]), np.concatenate([dst, src]))
         res = Simulator(topo=topo, n_msgs=4, mode="push", seed=0).run(16)
         assert res.coverage[-1] > 0.99
+
+
+class TestFrameBound:
+    """Round-2 advisor finding: a 4-byte prefix can declare up to 4 GiB;
+    unbounded, a corrupt/hostile peer stalls the stream while the buffer
+    grows without limit.  Both codec paths must reject prefixes above
+    MAX_FRAME_LEN the moment the 4 header bytes arrive."""
+
+    def test_scan_rejects_hostile_prefix(self):
+        hostile = (0xFFFFFFFF).to_bytes(4, "big") + b"junk"
+        with pytest.raises(native.FrameTooLargeError):
+            native.frame_scan(hostile)
+
+    def test_scan_rejects_prefix_after_valid_frames(self):
+        good = native.frame_encode(b'{"type":"gossip"}')
+        bad = (native.MAX_FRAME_LEN + 1).to_bytes(4, "big")
+        with pytest.raises(native.FrameTooLargeError):
+            native.frame_scan(good + bad)
+
+    def test_boundary_length_accepted(self):
+        # exactly MAX_FRAME_LEN is legal; only > is a violation
+        frames, consumed = native.frame_scan(
+            native.MAX_FRAME_LEN.to_bytes(4, "big"))  # partial frame
+        assert frames == [] and consumed == 0
+
+    def test_encode_rejects_oversize_payload(self):
+        with pytest.raises(native.FrameTooLargeError):
+            native.frame_encode(b"", max_len=-1)
+
+    def test_framed_stream_drops_connection(self):
+        import socket as socket_mod
+
+        from p2p_gossipprotocol_tpu.transport.socket_transport import (
+            FramedStream,
+        )
+
+        a, b = socket_mod.socketpair()
+        try:
+            stream = FramedStream(b)
+            a.sendall((0x7FFFFFFF).to_bytes(4, "big") + b"x" * 100)
+            assert stream.recv_objects() is None   # EOF-equivalent
+            assert stream._buf == b""              # nothing accumulated
+            assert b.fileno() == -1                # connection closed
+        finally:
+            a.close()

@@ -1,0 +1,106 @@
+"""Transport-seam tests: the round kernels are written against the
+abstract Transport, so swapping HOW bits move (OR-scatter over the HBM
+adjacency vs. a dense boolean matmul) must not change gossip semantics —
+bitwise, on the full feature set (fanout, churn-dead peers, byzantine).
+
+The dense transport here is the "small-n MXU path": materialize the
+adjacency as an n×n matrix and deliver via matmul — a genuinely
+different lowering from JaxTransport's gather/scatter, which is what
+makes the equality meaningful.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2p_gossipprotocol_tpu import graph as G
+from p2p_gossipprotocol_tpu.sim import Simulator
+from p2p_gossipprotocol_tpu.state import init_gossip_state
+from p2p_gossipprotocol_tpu.transport import (JaxTransport, SocketTransport,
+                                              Transport)
+
+
+class DenseMatmulTransport(Transport):
+    """Delivery as dense boolean matmuls — viable for small n, and a
+    distinct implementation of every seam primitive."""
+
+    def deliver(self, sending, topo, edge_gate=None):
+        gate = topo.edge_mask if edge_gate is None else (topo.edge_mask
+                                                         & edge_gate)
+        n = sending.shape[0]
+        adj = jnp.zeros((n, n), bool)
+        adj = adj.at[topo.dst, topo.src].max(gate, mode="drop")
+        return (adj.astype(jnp.float32)
+                @ sending.astype(jnp.float32)) > 0.5
+
+    def fetch(self, payload, nbr, ok):
+        n = payload.shape[0]
+        sel = jnp.where(ok, nbr, -1)
+        onehot = jax.nn.one_hot(sel, n, dtype=jnp.float32)
+        return (onehot @ payload.astype(jnp.float32)) > 0.5
+
+    def push_to(self, recv, payload, nbr, ok):
+        n = recv.shape[0]
+        sel = jnp.where(ok, nbr, -1)
+        onehot = jax.nn.one_hot(sel, n, dtype=jnp.float32)
+        pushed = (onehot.T @ payload.astype(jnp.float32)) > 0.5
+        return recv | pushed
+
+
+def _run(transport, mode, fanout=0, rounds=8):
+    topo = G.erdos_renyi(7, 128, avg_degree=8)
+    sim = Simulator(topo=topo, n_msgs=4, mode=mode, fanout=fanout,
+                    byzantine_fraction=0.1, seed=3, transport=transport)
+    return sim.run(rounds)
+
+
+@pytest.mark.parametrize("mode,fanout", [("push", 0), ("push", 3),
+                                         ("pull", 0), ("pushpull", 0)])
+def test_transport_swap_is_bitwise_invisible(mode, fanout):
+    a = _run(JaxTransport(), mode, fanout)
+    b = _run(DenseMatmulTransport(), mode, fanout)
+    assert (np.asarray(a.state.seen) == np.asarray(b.state.seen)).all()
+    assert (a.coverage == b.coverage).all()
+    assert (a.deliveries == b.deliveries).all()
+
+
+def test_jax_transport_primitives():
+    t = JaxTransport()
+    topo = G.erdos_renyi(0, 32, avg_degree=4)
+    state = init_gossip_state(topo, 2, jax.random.PRNGKey(0))
+
+    recv = t.deliver(state.seen, topo)
+    assert recv.shape == state.seen.shape and recv.dtype == jnp.bool_
+
+    nbr = jnp.zeros(32, jnp.int32)               # everyone contacts peer 0
+    ok = jnp.ones(32, bool).at[5].set(False)
+    fetched = t.fetch(state.seen, nbr, ok)
+    assert not np.asarray(fetched)[5].any()       # gated contact fails
+    assert (np.asarray(fetched)[0] == np.asarray(state.seen)[0]).all()
+
+    payload = jnp.ones((32, 2), bool)
+    out = t.push_to(jnp.zeros((32, 2), bool), payload, nbr, ok)
+    assert np.asarray(out)[0].all()               # peer 0 got pushed to
+    assert not np.asarray(out)[1:].any()
+
+
+def test_socket_transport_stands_alone():
+    """SocketTransport is runtime plumbing, not a simulation Transport —
+    it must instantiate without the array-seam abstract methods."""
+    st = SocketTransport("127.0.0.1", 0)
+    assert not isinstance(st, Transport)
+    st.start()
+    try:
+        assert st.listener is not None
+    finally:
+        st.stop()
+
+
+def test_default_transport_is_jax():
+    topo = G.erdos_renyi(7, 64, avg_degree=6)
+    sim = Simulator(topo=topo, n_msgs=2, seed=1)
+    explicit = Simulator(topo=topo, n_msgs=2, seed=1,
+                         transport=JaxTransport())
+    ra, rb = sim.run(4), explicit.run(4)
+    assert (np.asarray(ra.state.seen) == np.asarray(rb.state.seen)).all()
