@@ -216,6 +216,14 @@ int64_t gn_ba_edges(uint64_t seed, int64_t n, int32_t m, int32_t* src,
 }
 
 // ---------------------------------------------------------------------------
+// ABI version — bumped whenever any exported signature changes (v2: the
+// gn_frame_scan max_len parameter).  The Python loader refuses a library
+// whose version doesn't match and falls back to the pure-Python paths, so
+// a stale prebuilt .so can never silently run with mismatched signatures.
+// ---------------------------------------------------------------------------
+int64_t gn_abi_version() { return 2; }
+
+// ---------------------------------------------------------------------------
 // Length-framed message codec (4-byte big-endian length prefix) — the
 // framing the reference's wire protocol lacks (SURVEY.md §2-C7).
 // ---------------------------------------------------------------------------

@@ -46,6 +46,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mode", choices=["push", "pull", "pushpull", "sir"],
                    default=None,
                    help="gossip mode override (sir = epidemic model)")
+    p.add_argument("--graph",
+                   choices=["reference", "er", "ba", "powerlaw"],
+                   default=None,
+                   help="jax mode: overlay model override (same as the "
+                        "graph= config key)")
     p.add_argument("--engine", choices=["edges", "aligned"],
                    default="edges",
                    help="jax mode: exact edge-list engine, or the "
@@ -306,6 +311,8 @@ def main(argv: list[str] | None = None) -> int:
         cfg.local_port = args.local_port
     if args.mode:
         cfg.mode = args.mode
+    if args.graph:
+        cfg.graph = args.graph
 
     if not args.quiet:
         print(cfg.to_string())  # main.cpp:48

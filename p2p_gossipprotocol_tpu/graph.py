@@ -173,20 +173,28 @@ def barabasi_albert(seed: int, n: int, m: int = 4) -> Topology:
     # Seed clique of m+1 nodes.
     m0 = m + 1
     seed_src, seed_dst = np.triu_indices(m0, k=1)
-    endpoints = list(np.concatenate([seed_src, seed_dst]))
+    # Flat preallocated endpoints array (the repeated-endpoints trick):
+    # sampling an index < k is sampling ∝ degree.  Preallocation keeps the
+    # build O(E) — rebuilding the pool per node is O(n·E), minutes at
+    # n=100k (the round-3 BA-100k baseline hang).
+    cap = 2 * (seed_src.size + (n - m0) * m) + 16
+    endpoints = np.empty(cap, np.int64)
+    k = 2 * seed_src.size
+    endpoints[:seed_src.size] = seed_src
+    endpoints[seed_src.size:k] = seed_dst
     srcs = [np.asarray(seed_src, np.int64)]
     dsts = [np.asarray(seed_dst, np.int64)]
-    # Pre-draw randomness; sample targets from the endpoints list (∝ degree).
     for v in range(m0, n):
-        pool = np.asarray(endpoints, dtype=np.int64)
-        targets = np.unique(pool[rng.integers(0, len(pool), size=2 * m)])[:m]
+        targets = np.unique(endpoints[rng.integers(0, k, size=2 * m)])[:m]
         while targets.size < m:  # rare: top up with uniform others
             extra = rng.integers(0, v, size=m)
             targets = np.unique(np.concatenate([targets, extra]))[:m]
-        srcs.append(np.full(targets.size, v, np.int64))
+        t = targets.size
+        srcs.append(np.full(t, v, np.int64))
         dsts.append(targets)
-        endpoints.extend([v] * targets.size)
-        endpoints.extend(targets.tolist())
+        endpoints[k:k + t] = v
+        endpoints[k + t:k + 2 * t] = targets
+        k += 2 * t
     src = np.concatenate(srcs)
     dst = np.concatenate(dsts)
     return _pad_and_build(n, np.concatenate([src, dst]),

@@ -17,6 +17,9 @@ import numpy as np
 _LIB = None
 _TRIED = False
 
+# Must match gn_abi_version() in native/gossip_native.cpp.
+ABI_VERSION = 2
+
 
 def _lib_path() -> str:
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -34,6 +37,17 @@ def _load():
     try:
         lib = ctypes.CDLL(path)
     except OSError:
+        return None
+    # Refuse a stale build: calling a changed signature through ctypes
+    # doesn't fail, it silently misbehaves (e.g. a 4-arg gn_frame_scan
+    # would ignore the max_len cap entirely).  Version mismatch — or a
+    # pre-versioning .so with no gn_abi_version at all — falls back to
+    # the pure-Python paths, which are always current.
+    try:
+        lib.gn_abi_version.restype = ctypes.c_int64
+        if int(lib.gn_abi_version()) != ABI_VERSION:
+            return None
+    except AttributeError:
         return None
     lib.gn_sha256.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
                               ctypes.c_char_p]

@@ -25,7 +25,7 @@ import random
 import threading
 import time
 
-from p2p_gossipprotocol_tpu.info import (Message, PeerInfo,
+from p2p_gossipprotocol_tpu.info import (Message, MessageTracker, PeerInfo,
                                          calculate_message_hash)
 from p2p_gossipprotocol_tpu.transport.socket_transport import (
     WIRE_FORMATS, SocketTransport)
@@ -40,7 +40,8 @@ class PeerNode:
                  max_messages: int = 10, max_missed_pings: int = 3,
                  powerlaw_alpha: float = 2.5, log_dir: str = ".",
                  rng: random.Random | None = None,
-                 wire_format: str = "json"):
+                 wire_format: str = "json",
+                 generation_delay_s: float = 0.0):
         self.ip = ip
         self.port = port
         self.seeds = seeds
@@ -49,6 +50,12 @@ class PeerNode:
         self.max_messages = max_messages
         self.max_missed_pings = max_missed_pings
         self.powerlaw_alpha = powerlaw_alpha
+        # Hold message generation for this long after start(): flood-once
+        # gossip never re-sends old rumors, so peers that join after a
+        # message was flooded miss it forever (reference semantics).  A
+        # deployment that wants every message everywhere starts
+        # generating only once the membership has formed.
+        self.generation_delay_s = generation_delay_s
         self.rng = rng or random.Random()
         # "json" = reference byte-compatible unframed wire; "framed" =
         # length-prefixed robust mode (SURVEY.md §2-C7)
@@ -59,8 +66,11 @@ class PeerNode:
         # (ip, port) -> outbound socket   (reference connectedPeers)
         self.connected_peers: dict[tuple[str, int], object] = {}
         self.peers_lock = threading.Lock()
-        # message hash -> Message          (reference messageList)
-        self.message_list: dict[str, Message] = {}
+        # message hash -> MessageTracker   (reference messageList,
+        # peer.hpp:23-26 — but unlike the reference, sent_to is READ:
+        # _broadcast skips peers already sent to, making send-exactly-once
+        # an enforced invariant rather than dead state, SURVEY §2-C4)
+        self.message_list: dict[str, MessageTracker] = {}
         self.message_lock = threading.Lock()
         # (ip, port) -> consecutive failed probes (reference pingStatus)
         self.ping_status: dict[tuple[str, int], int] = {}
@@ -167,9 +177,14 @@ class PeerNode:
         count = min(n, int(n * u ** (1.0 / self.powerlaw_alpha)))
         candidates = list(peers)
         self.rng.shuffle(candidates)
-        for peer in candidates[:count]:
+        made = 0
+        for peer in candidates:
+            if made >= count:
+                break
             if peer.ip == self.ip and peer.port == self.port:
-                continue  # skip self (peer.cpp:230)
+                continue  # skip self (peer.cpp:230) — the seed's reply
+                # includes the registrant, and letting self consume a
+                # fanout slot leaves small overlays edgeless
             key = (peer.ip, peer.port)
             with self.peers_lock:
                 if key in self.connected_peers:
@@ -177,6 +192,11 @@ class PeerNode:
             sock = SocketTransport.connect(peer.ip, peer.port)
             if sock is None:
                 continue
+            # The connect timeout must not outlive the handshake: left in
+            # place it fires on every recv() after a 2 s lull in gossip,
+            # and the reader treats socket.timeout (an OSError) as EOF —
+            # silently severing healthy long-lived connections.
+            sock.settimeout(None)
             with self.peers_lock:
                 self.connected_peers[key] = sock
             with self.ping_lock:
@@ -185,6 +205,7 @@ class PeerNode:
                                  args=(sock, key), daemon=True)
             t.start()
             self._track(t)
+            made += 1
             self.log.log(f"Connected to peer: {peer.ip}:{peer.port}")
 
     # -- serving (peer.cpp:87-101, 255-295) ----------------------------
@@ -223,7 +244,7 @@ class PeerNode:
         with self.message_lock:
             if msg_hash in self.message_list:
                 return
-            self.message_list[msg_hash] = msg
+            self.message_list[msg_hash] = MessageTracker(msg)
         # relay OUTSIDE the dedup lock: the reference re-locks messageMutex
         # inside broadcastMessage while already holding it — UB/deadlock
         # (peer.cpp:280-314); our lock is released before the relay.
@@ -232,18 +253,37 @@ class PeerNode:
         self._broadcast(msg, exclude_conn=inbound_conn)
 
     def _broadcast(self, msg: Message, exclude_conn=None) -> None:
+        """Send to every connected peer not yet sent this message.
+
+        ``sent_to`` is consulted and updated, so re-broadcasting the same
+        message (e.g. after the overlay is replenished post-eviction)
+        never sends a duplicate to a peer that already got it — the
+        invariant tests/test_socket_stress.py asserts."""
         payload = msg.to_wire()
+        with self.message_lock:
+            tracker = self.message_list.get(msg.hash)
+            already = set(tracker.sent_to) if tracker else set()
         with self.peers_lock:
             targets = [(k, s) for k, s in self.connected_peers.items()
-                       if s is not exclude_conn]
+                       if s is not exclude_conn and k not in already]
+        sent = []
         for key, sock in targets:
             try:
                 self._send(sock, payload)
+                sent.append(key)
             except OSError:
                 pass
+        if sent:
+            with self.message_lock:
+                tracker = self.message_list.get(msg.hash)
+                if tracker is not None:
+                    tracker.sent_to.update(sent)
 
     # -- generation (peer.cpp:357-379) ---------------------------------
     def _message_generation_loop(self) -> None:
+        deadline = time.time() + self.generation_delay_s
+        while self.running and time.time() < deadline:
+            time.sleep(0.05)
         counter = 0
         while self.running and counter < self.max_messages:
             msg = Message(
@@ -255,7 +295,7 @@ class PeerNode:
             )
             msg.hash = calculate_message_hash(msg)
             with self.message_lock:
-                self.message_list[msg.hash] = msg
+                self.message_list[msg.hash] = MessageTracker(msg)
             self._broadcast(msg)
             self.log.log(f"Generated message: {msg.content} #{counter}")
             counter += 1

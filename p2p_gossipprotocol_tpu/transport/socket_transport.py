@@ -50,9 +50,12 @@ class JsonStream:
 
     def recv_objects(self) -> list[dict] | None:
         """Block for one recv; return parsed docs (possibly several, or
-        none yet) — or None on EOF."""
+        none yet) — or None on EOF.  A recv timeout is NOT EOF: the
+        connection is healthy, there is just nothing to read yet."""
         try:
             chunk = self.sock.recv(RECV_SIZE)
+        except socket.timeout:
+            return []
         except OSError:
             return None
         if not chunk:
@@ -94,6 +97,8 @@ class FramedStream:
 
         try:
             chunk = self.sock.recv(RECV_SIZE)
+        except socket.timeout:
+            return []           # no data yet ≠ EOF (see JsonStream)
         except OSError:
             return None
         if not chunk:

@@ -53,6 +53,7 @@ class Peer:
             self._running = False
             self._stop_event = threading.Event()
             self.rounds_completed = 0   # chunks landed so far (jax)
+            self._error: Exception | None = None
 
     #: rounds per jitted scan call on the jax backend — the stop() check
     #: granularity.  Small enough that stop() returns promptly, large
@@ -69,39 +70,50 @@ class Peer:
         # checked between chunks, so stop() actually interrupts the run
         # (a single monolithic scan is uninterruptible — the reference's
         # stop() really stops its threads, wrapper.cpp:27-30, and ours
-        # must too).  Chunks of one fixed size share one compiled program.
+        # must too).  Full chunks share one compiled program; a final
+        # partial chunk (rounds % JAX_ROUND_CHUNK) compiles once more,
+        # and that compile time lands in the summed wall_s.
         def _run():
             import numpy as np
 
             from p2p_gossipprotocol_tpu.sim import SimResult
 
             state, topo, parts, wall, done = None, None, [], 0.0, 0
-            while done < rounds and not self._stop_event.is_set():
-                step = min(self.JAX_ROUND_CHUNK, rounds - done)
-                r = self._sim.run(step, state=state, topo=topo)
-                parts.append(r)
-                state, topo = r.state, r.topo
-                wall += r.wall_s
-                done += step
-                self.rounds_completed = done
-            if parts:
-                self._result = SimResult(
-                    state=state, topo=topo,
-                    coverage=np.concatenate([p.coverage for p in parts]),
-                    deliveries=np.concatenate(
-                        [p.deliveries for p in parts]),
-                    frontier_size=np.concatenate(
-                        [p.frontier_size for p in parts]),
-                    live_peers=np.concatenate(
-                        [p.live_peers for p in parts]),
-                    evictions=np.concatenate(
-                        [p.evictions for p in parts]),
-                    wall_s=wall,
-                )
-            self._running = False
+            try:
+                while done < rounds and not self._stop_event.is_set():
+                    step = min(self.JAX_ROUND_CHUNK, rounds - done)
+                    r = self._sim.run(step, state=state, topo=topo)
+                    parts.append(r)
+                    state, topo = r.state, r.topo
+                    wall += r.wall_s
+                    done += step
+                    self.rounds_completed = done
+                if parts:
+                    self._result = SimResult(
+                        state=state, topo=topo,
+                        coverage=np.concatenate(
+                            [p.coverage for p in parts]),
+                        deliveries=np.concatenate(
+                            [p.deliveries for p in parts]),
+                        frontier_size=np.concatenate(
+                            [p.frontier_size for p in parts]),
+                        live_peers=np.concatenate(
+                            [p.live_peers for p in parts]),
+                        evictions=np.concatenate(
+                            [p.evictions for p in parts]),
+                        wall_s=wall,
+                    )
+            except Exception as e:  # noqa: BLE001 — surface via join()
+                # Without this, a mid-chunk failure (trace error, OOM)
+                # would leave is_running() True forever and join() would
+                # return None with no explanation.
+                self._error = e
+            finally:
+                self._running = False
 
         self._stop_event.clear()
         self.rounds_completed = 0
+        self._error = None
         self._running = True
         self._thread = threading.Thread(target=_run, daemon=True)
         self._thread.start()
@@ -126,8 +138,13 @@ class Peer:
 
     # -- jax-backend extras --------------------------------------------
     def join(self, timeout: float | None = None):
+        """Wait for the run; re-raises a worker-thread failure rather
+        than silently returning None (partial chunks, if any, stay in
+        ``result``)."""
         if self._thread is not None:
             self._thread.join(timeout)
+        if getattr(self, "_error", None) is not None:
+            raise self._error
         return self._result
 
     @property

@@ -3,11 +3,14 @@
 import io
 import json
 import subprocess
+from pathlib import Path
 import sys
 
 from p2p_gossipprotocol_tpu import graph
 from p2p_gossipprotocol_tpu.sim import Simulator
 from p2p_gossipprotocol_tpu.utils import metrics
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
 
 
 def test_emit_jsonl_and_summary():
@@ -36,13 +39,13 @@ def test_cli_metrics_jsonl(tmp_path):
     out = tmp_path / "metrics.jsonl"
     proc = subprocess.run(
         [sys.executable, "-m", "p2p_gossipprotocol_tpu.cli",
-         "/root/reference/network.txt", "--backend", "jax",
+         str(REPO_ROOT / "network.txt"), "--backend", "jax",
          "--n-peers", "200", "--rounds", "6", "--quiet",
          "--metrics-jsonl", str(out)],
         capture_output=True, text=True, timeout=300,
-        env={"PYTHONPATH": "/root/repo", "JAX_PLATFORMS": "cpu",
+        env={"PYTHONPATH": str(REPO_ROOT), "JAX_PLATFORMS": "cpu",
              "PATH": "/usr/bin:/bin:/usr/local/bin"},
-        cwd="/root/repo")
+        cwd=str(REPO_ROOT))
     assert proc.returncode == 0, proc.stderr
     result = json.loads(proc.stdout.strip().splitlines()[-1])
     assert result["n_peers"] == 200
@@ -64,9 +67,9 @@ def test_cli_aligned_clamps_are_surfaced(tmp_path):
          "--backend", "jax", "--engine", "aligned", "--rounds", "8",
          "--quiet"],
         capture_output=True, text=True, timeout=300,
-        env={"PYTHONPATH": "/root/repo", "JAX_PLATFORMS": "cpu",
+        env={"PYTHONPATH": str(REPO_ROOT), "JAX_PLATFORMS": "cpu",
              "PATH": "/usr/bin:/bin:/usr/local/bin"},
-        cwd="/root/repo")
+        cwd=str(REPO_ROOT))
     assert proc.returncode == 0, proc.stderr
     assert "clamped avg_degree 200 -> 127" in proc.stderr
     assert "clamped n_messages 40 -> 32" in proc.stderr
@@ -88,9 +91,9 @@ def test_cli_sir_mode(tmp_path):
          "--backend", "jax", "--rounds", "25", "--quiet",
          "--metrics-jsonl", str(out)],
         capture_output=True, text=True, timeout=300,
-        env={"PYTHONPATH": "/root/repo", "JAX_PLATFORMS": "cpu",
+        env={"PYTHONPATH": str(REPO_ROOT), "JAX_PLATFORMS": "cpu",
              "PATH": "/usr/bin:/bin:/usr/local/bin"},
-        cwd="/root/repo")
+        cwd=str(REPO_ROOT))
     assert proc.returncode == 0, proc.stderr
     result = json.loads(proc.stdout.strip().splitlines()[-1])
     assert result["mode"] == "sir"
@@ -108,13 +111,13 @@ def test_cli_sir_mode(tmp_path):
 def test_cli_aligned_engine(tmp_path):
     proc = subprocess.run(
         [sys.executable, "-m", "p2p_gossipprotocol_tpu.cli",
-         "/root/reference/network.txt", "--backend", "jax",
+         str(REPO_ROOT / "network.txt"), "--backend", "jax",
          "--engine", "aligned", "--n-peers", "1024", "--rounds", "10",
          "--quiet"],
         capture_output=True, text=True, timeout=300,
-        env={"PYTHONPATH": "/root/repo", "JAX_PLATFORMS": "cpu",
+        env={"PYTHONPATH": str(REPO_ROOT), "JAX_PLATFORMS": "cpu",
              "PATH": "/usr/bin:/bin:/usr/local/bin"},
-        cwd="/root/repo")
+        cwd=str(REPO_ROOT))
     assert proc.returncode == 0, proc.stderr
     result = json.loads(proc.stdout.strip().splitlines()[-1])
     assert result["engine"] == "aligned"

@@ -75,8 +75,8 @@ def test_seed_register_and_gossip(tmp_path, wire_format):
             # gossip flows: b generates messages; a must dedup-store them
             def a_heard_b():
                 with a.message_lock:
-                    return any(m.source_port == b.port
-                               for m in a.message_list.values())
+                    return any(t.msg.source_port == b.port
+                               for t in a.message_list.values())
             assert _wait(a_heard_b, timeout=15.0)
             # dedup: message count stays bounded by senders' max_messages
             with a.message_lock:
