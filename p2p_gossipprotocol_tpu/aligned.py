@@ -95,10 +95,23 @@ def build_aligned(seed: int, n: int, n_slots: int = 16,
     # preferring multiples of 8; choosing a divisor instead of rounding
     # rows up to blk*n_shards is what bounds the padding (rounding up
     # would add ~26% phantom peers at the 10M/64-shard config).
+    #
+    # No minimum-row floor: a forced 8-row layout at small n makes MOST
+    # rows black holes — at n=256 that starved every peer below one live
+    # in-neighbor on average and dissemination died entirely (round-3
+    # regression test test_aligned.py::test_small_n_converges).
     for align in (8, 4, 2, 1):
-        rows = -(-max(rows0, 8) // (align * n_shards)) * align * n_shards
+        rows = -(-rows0 // (align * n_shards)) * align * n_shards
         if rows - rows0 <= max(rows0 // 16, 0) or align == 1:
             break
+    if rows - rows0 > max(rows0 // 4, 0):
+        # >25% black-hole rows silently starves the overlay of live
+        # in-neighbors (dissemination stalls well short of coverage) —
+        # refuse instead, like every other never-silently-weaken check.
+        raise ValueError(
+            f"{n} peers fill only {rows0} of the {rows} rows an "
+            f"{n_shards}-shard layout needs — the padding rows would eat "
+            "most in-edges; use fewer shards or the edge engine")
     local = rows // n_shards
     cap = min(rowblk, local)
     blk = next((d for d in range(cap - cap % 8, 0, -8) if local % d == 0),
@@ -238,6 +251,17 @@ class AlignedSimulator:
             self.churn = ChurnConfig()
         if self.interpret is None:
             self.interpret = jax.default_backend() not in ("tpu", "axon")
+        if not self.interpret and (self.topo.rows < 8
+                                   or self.topo.rowblk % 8):
+            # Mosaic requires the kernel's block shape — (rowblk, 128) —
+            # to tile (8, 128) sublanes; fewer rows or a non-multiple-of-8
+            # row block compile-errors deep inside the kernel.  Interpret
+            # mode (CPU) handles any layout.
+            raise ValueError(
+                f"aligned engine on TPU needs >= 8 rows of {LANES} peers "
+                f"and an 8-aligned row block (this overlay: "
+                f"{self.topo.rows} rows, rowblk {self.topo.rowblk}) — "
+                "use the edge engine, a larger overlay, or fewer shards")
         self._n_honest = (self.n_honest_msgs
                           if self.n_honest_msgs is not None else self.n_msgs)
         if not 0 < self._n_honest <= self.n_msgs:

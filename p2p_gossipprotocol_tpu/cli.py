@@ -55,6 +55,10 @@ def build_parser() -> argparse.ArgumentParser:
                    default="edges",
                    help="jax mode: exact edge-list engine, or the "
                         "hardware-aligned pallas engine (1M+ peers)")
+    p.add_argument("--mesh-devices", type=int, default=0, metavar="N",
+                   help="jax mode: shard the peer axis over an N-device "
+                        "mesh (ShardedSimulator / "
+                        "AlignedShardedSimulator); 0 = single device")
     p.add_argument("--target-coverage", type=float, default=0.99)
     p.add_argument("--local-ip", default=None)
     p.add_argument("--local-port", type=int, default=None)
@@ -76,6 +80,10 @@ def _run_jax(cfg: NetworkConfig, args) -> int:
                 print("Error: --engine aligned does not run the SIR model "
                       "(use --engine edges)", file=sys.stderr)
                 return 1
+            if args.mesh_devices > 1:
+                print("Error: --mesh-devices does not apply to the SIR "
+                      "model (single-device only)", file=sys.stderr)
+                return 1
             return _run_jax_sir(cfg, args, rounds, metrics_lib)
         if args.engine == "aligned":
             return _run_jax_aligned(cfg, args, rounds, metrics_lib)
@@ -83,12 +91,32 @@ def _run_jax(cfg: NetworkConfig, args) -> int:
         from p2p_gossipprotocol_tpu.sim import Simulator
 
         sim = Simulator.from_config(cfg, n_peers=args.n_peers)
+        engine = "edges"
+        if args.mesh_devices > 1:
+            # Same scenario, sharded over the mesh: from_config resolved
+            # every knob (junk columns, churn, strikes); lift them onto
+            # the drop-in multi-chip simulator.
+            from p2p_gossipprotocol_tpu.parallel import (ShardedSimulator,
+                                                         make_mesh)
+
+            try:
+                sim = ShardedSimulator(
+                    topo=sim.topo, mesh=make_mesh(args.mesh_devices),
+                    n_msgs=sim.n_msgs, mode=sim.mode, fanout=sim.fanout,
+                    churn=sim.churn,
+                    byzantine_fraction=sim.byzantine_fraction,
+                    n_honest_msgs=sim.n_honest_msgs,
+                    max_strikes=sim.max_strikes, seed=sim.seed)
+            except ValueError as e:
+                print(f"Error: {e}", file=sys.stderr)
+                return 1
+            engine = f"edges-sharded-{args.mesh_devices}"
         if not args.quiet:
             print(f"[jax] simulating {sim.topo.n_peers} peers, "
                   f"{sim.n_msgs} messages, mode={sim.mode}, "
-                  f"{int(sim.topo.n_edges())} edges")
+                  f"{int(sim.topo.n_edges())} edges, engine={engine}")
         res = sim.run(rounds)
-    _report(res, sim, n_peers=sim.topo.n_peers, engine="edges",
+    _report(res, sim, n_peers=sim.topo.n_peers, engine=engine,
             args=args, metrics_lib=metrics_lib)
     return 0
 
@@ -182,8 +210,17 @@ def _run_jax_aligned(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
         clamps.append(f"avg_degree {n_slots} -> 127 "
                       "(aligned engine slot index is int8)")
         n_slots = 127
-    topo = build_aligned(seed=cfg.prng_seed, n=n, n_slots=n_slots,
-                         degree_law=law, powerlaw_alpha=cfg.powerlaw_alpha)
+    n_shards = max(1, args.mesh_devices)
+    try:
+        topo = build_aligned(seed=cfg.prng_seed, n=n, n_slots=n_slots,
+                             degree_law=law,
+                             powerlaw_alpha=cfg.powerlaw_alpha,
+                             n_shards=n_shards)
+    except ValueError as e:
+        # e.g. the overlay is too small to shard without black-hole
+        # padding rows — same clean-exit contract as the engine checks
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
     n_msgs = cfg.n_messages or cfg.max_message_count
     if n_msgs > 32:
         clamps.append(f"n_messages {n_msgs} -> 32 "
@@ -201,14 +238,22 @@ def _run_jax_aligned(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
         n_msgs = n_msgs + n_junk
     for c in clamps:
         print(f"Warning: --engine aligned clamped {c}", file=sys.stderr)
+    engine = "aligned"
     try:
-        sim = AlignedSimulator(
-            topo=topo, n_msgs=n_msgs, mode=mode,
-            churn=ChurnConfig(rate=cfg.churn_rate),
-            byzantine_fraction=cfg.byzantine_fraction,
-            n_honest_msgs=n_honest,
-            max_strikes=cfg.max_missed_pings,
-            seed=cfg.prng_seed)
+        kw = dict(topo=topo, n_msgs=n_msgs, mode=mode,
+                  churn=ChurnConfig(rate=cfg.churn_rate),
+                  byzantine_fraction=cfg.byzantine_fraction,
+                  n_honest_msgs=n_honest,
+                  max_strikes=cfg.max_missed_pings,
+                  seed=cfg.prng_seed)
+        if n_shards > 1:
+            from p2p_gossipprotocol_tpu.parallel import (
+                AlignedShardedSimulator, make_mesh)
+
+            sim = AlignedShardedSimulator(mesh=make_mesh(n_shards), **kw)
+            engine = f"aligned-sharded-{n_shards}"
+        else:
+            sim = AlignedSimulator(**kw)
     except ValueError as e:
         # e.g. max_missed_pings outside the engine's int8 strike range —
         # values --engine edges accepts; fail cleanly like the mode/fanout
@@ -219,9 +264,9 @@ def _run_jax_aligned(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
         print(f"[jax/aligned] simulating {n} peers, {n_msgs} messages, "
               f"mode={mode}, {sim.topo.n_slots} slots/peer, "
               f"churn={cfg.churn_rate:g}, "
-              f"byzantine={cfg.byzantine_fraction:g}")
+              f"byzantine={cfg.byzantine_fraction:g}, engine={engine}")
     res = sim.run(rounds)
-    _report(res, sim, n_peers=n, engine="aligned",
+    _report(res, sim, n_peers=n, engine=engine,
             args=args, metrics_lib=metrics_lib, clamps=clamps)
     return 0
 

@@ -317,3 +317,32 @@ def test_churn_dynamics_match_exact_engine_statistically():
     r_exact = res_e.rounds_to(0.99)
     assert r_exact > 0
     assert abs(r_aligned - r_exact) <= 3, (r_aligned, r_exact)
+
+
+def test_small_n_converges():
+    """Regression: the layout used to force >= 8 rows, making most rows
+    black-hole padding at small n — at n=256 every peer averaged under
+    one live in-neighbor and dissemination died entirely (round-3 find).
+    Small overlays now get exact row counts and must converge."""
+    for n, slots in [(128, 8), (256, 8), (512, 6)]:
+        topo = build_aligned(seed=1, n=n, n_slots=slots,
+                             degree_law="regular")
+        assert topo.rows == max(1, -(-n // 128))
+        sim = AlignedSimulator(topo=topo, n_msgs=4, mode="pushpull",
+                               seed=1)
+        res = sim.run(24)
+        assert float(res.coverage[-1]) == 1.0, (n, slots)
+
+
+def test_tpu_path_rejects_sub_tile_layouts():
+    """The real-TPU (non-interpret) kernel tiles (8, 128) sublanes: both
+    a sub-8-row overlay and a non-8-aligned row block must fail loudly at
+    construction, not compile-error deep inside mosaic."""
+    topo = build_aligned(seed=1, n=256, n_slots=4)
+    with pytest.raises(ValueError, match="8 rows"):
+        AlignedSimulator(topo=topo, n_msgs=4, interpret=False)
+    # rows=8 but rowblk=1 (an 8-shard layout of 1024 peers): also rejected
+    topo = build_aligned(seed=1, n=1024, n_slots=4, n_shards=8)
+    assert topo.rows == 8 and topo.rowblk == 1
+    with pytest.raises(ValueError, match="row block"):
+        AlignedSimulator(topo=topo, n_msgs=4, interpret=False)

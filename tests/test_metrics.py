@@ -122,3 +122,47 @@ def test_cli_aligned_engine(tmp_path):
     result = json.loads(proc.stdout.strip().splitlines()[-1])
     assert result["engine"] == "aligned"
     assert result["final_coverage"] > 0.99
+
+
+def test_cli_mesh_devices(tmp_path):
+    """--mesh-devices N runs the drop-in sharded engines from the CLI
+    (multi-chip entry point) on the 8-device virtual CPU mesh."""
+    cfg = tmp_path / "net.txt"
+    # n_peers >= 1024: an 8-shard aligned layout needs 8 live rows of 128
+    # lanes (build_aligned refuses overlays that would be mostly
+    # black-hole padding rows)
+    cfg.write_text("10.0.0.1:8000\n"
+                   "graph=er\nn_peers=1024\navg_degree=8\nmode=pushpull\n"
+                   "n_messages=4\nprng_seed=1\n")
+    env = {"PYTHONPATH": str(REPO_ROOT), "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+           "PATH": "/usr/bin:/bin:/usr/local/bin"}
+    for engine, expect in [("edges", "edges-sharded-8"),
+                           ("aligned", "aligned-sharded-8")]:
+        proc = subprocess.run(
+            [sys.executable, "-m", "p2p_gossipprotocol_tpu.cli", str(cfg),
+             "--backend", "jax", "--engine", engine,
+             "--mesh-devices", "8", "--rounds", "12", "--quiet"],
+            capture_output=True, text=True, timeout=300,
+            env=env, cwd=str(REPO_ROOT))
+        assert proc.returncode == 0, (engine, proc.stderr)
+        result = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert result["engine"] == expect
+        assert result["final_coverage"] > 0.99
+
+
+def test_cli_mesh_devices_too_many(tmp_path):
+    """Requesting more devices than exist fails cleanly, no traceback."""
+    cfg = tmp_path / "net.txt"
+    cfg.write_text("10.0.0.1:8000\ngraph=er\nn_peers=64\nmode=push\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "p2p_gossipprotocol_tpu.cli", str(cfg),
+         "--backend", "jax", "--mesh-devices", "64", "--rounds", "2",
+         "--quiet"],
+        capture_output=True, text=True, timeout=300,
+        env={"PYTHONPATH": str(REPO_ROOT), "JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+             "PATH": "/usr/bin:/bin:/usr/local/bin"},
+        cwd=str(REPO_ROOT))
+    assert proc.returncode == 1
+    assert "Error:" in proc.stderr and "Traceback" not in proc.stderr
