@@ -104,7 +104,7 @@ def build_aligned(seed: int, n: int, n_slots: int = 16,
         rows = -(-rows0 // (align * n_shards)) * align * n_shards
         if rows - rows0 <= max(rows0 // 16, 0) or align == 1:
             break
-    if rows - rows0 > max(rows0 // 4, 0):
+    if rows - rows0 > rows0 // 4:
         # >25% black-hole rows silently starves the overlay of live
         # in-neighbors (dissemination stalls well short of coverage) —
         # refuse instead, like every other never-silently-weaken check.
@@ -227,7 +227,7 @@ class AlignedSimulator:
 
     topo: AlignedTopology
     n_msgs: int = 16
-    mode: str = "push"           # push | pushpull
+    mode: str = "push"           # push | pull | pushpull
     churn: ChurnConfig = None    # type: ignore[assignment]
     byzantine_fraction: float = 0.0
     n_honest_msgs: int | None = None   # None → all columns honest
@@ -239,7 +239,7 @@ class AlignedSimulator:
         if not 0 < self.n_msgs <= MAX_PACKED_MSGS:
             raise ValueError(
                 f"aligned engine packs <= {MAX_PACKED_MSGS} messages")
-        if self.mode not in ("push", "pushpull"):
+        if self.mode not in ("push", "pull", "pushpull"):
             raise ValueError(f"Unknown gossip mode: {self.mode}")
         if not 0 < self.max_strikes <= 126:
             # strikes are int8 clamped at max_strikes + 1; 127 would wrap
@@ -479,14 +479,17 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
         seen_w = seen_w | inject
         frontier_w = frontier_w | inject
 
-    # Dead peers don't send; byzantine peers never relay (suppression,
-    # models/gossip.py:50-58) — both masked at the source words.
-    send = frontier_w & alive_w & ~state.byz_w
-    y = jnp.take(gather(send), topo.perm, axis=0)
-    recv = gossip_pass(y, topo.colidx, topo.deg, rolls_off,
-                       topo.subrolls, pull=False, rowblk=topo.rowblk,
-                       interpret=sim.interpret)
-    if sim.mode == "pushpull":
+    if sim.mode in ("push", "pushpull"):
+        # Dead peers don't send; byzantine peers never relay (suppression,
+        # models/gossip.py:50-58) — both masked at the source words.
+        send = frontier_w & alive_w & ~state.byz_w
+        y = jnp.take(gather(send), topo.perm, axis=0)
+        recv = gossip_pass(y, topo.colidx, topo.deg, rolls_off,
+                           topo.subrolls, pull=False, rowblk=topo.rowblk,
+                           interpret=sim.interpret)
+    else:                       # pure anti-entropy pull
+        recv = jnp.zeros_like(seen_w)
+    if sim.mode in ("pull", "pushpull"):
         # Anti-entropy: each peer pulls one random slot's neighbor's
         # full seen-set; dead/byzantine neighbors serve nothing
         # (gossip.py pull_round's alive[nbr] & ~byzantine[nbr]).

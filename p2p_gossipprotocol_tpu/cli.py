@@ -62,6 +62,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--target-coverage", type=float, default=0.99)
     p.add_argument("--local-ip", default=None)
     p.add_argument("--local-port", type=int, default=None)
+    p.add_argument("--wire-format", choices=["json", "framed"],
+                   default=None,
+                   help="socket mode: reference-compatible unframed JSON "
+                        "or length-framed (same as the wire_format= "
+                        "config key)")
     p.add_argument("--metrics-jsonl", default=None, metavar="PATH",
                    help="write per-round metrics as JSONL")
     p.add_argument("--profile-dir", default=None, metavar="DIR",
@@ -74,6 +79,17 @@ def _run_jax(cfg: NetworkConfig, args) -> int:
     from p2p_gossipprotocol_tpu.utils import metrics as metrics_lib
 
     rounds = args.rounds or cfg.rounds or 64
+    if args.mesh_devices > 1:
+        # Fail fast BEFORE topology construction — building a 10M-peer
+        # overlay only to learn the mesh doesn't exist wastes tens of
+        # seconds and GBs of host RAM.
+        import jax
+
+        have = len(jax.devices())
+        if args.mesh_devices > have:
+            print(f"Error: requested {args.mesh_devices} devices, "
+                  f"have {have}", file=sys.stderr)
+            return 1
     with metrics_lib.profile(args.profile_dir):
         if cfg.mode == "sir":
             if args.engine == "aligned":
@@ -177,10 +193,9 @@ def _run_jax_aligned(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
     from p2p_gossipprotocol_tpu.liveness import ChurnConfig
 
     n = args.n_peers or cfg.n_peers or len(cfg.seed_nodes)
-    if cfg.mode not in ("push", "pushpull"):
-        print(f"Error: --engine aligned supports push/pushpull, "
-              f"not {cfg.mode!r} (use --engine edges for pull)",
-              file=sys.stderr)
+    if cfg.mode not in ("push", "pull", "pushpull"):
+        print(f"Error: --engine aligned supports push/pull/pushpull, "
+              f"not {cfg.mode!r}", file=sys.stderr)
         return 1
     if cfg.fanout:
         # Never silently weaken the configured scenario: the aligned
@@ -358,6 +373,8 @@ def main(argv: list[str] | None = None) -> int:
         cfg.mode = args.mode
     if args.graph:
         cfg.graph = args.graph
+    if args.wire_format:
+        cfg.wire_format = args.wire_format
 
     if not args.quiet:
         print(cfg.to_string())  # main.cpp:48

@@ -346,3 +346,16 @@ def test_tpu_path_rejects_sub_tile_layouts():
     assert topo.rows == 8 and topo.rowblk == 1
     with pytest.raises(ValueError, match="row block"):
         AlignedSimulator(topo=topo, n_msgs=4, interpret=False)
+
+
+def test_pull_mode_converges():
+    """Pure anti-entropy pull (no push pass): one random contact per peer
+    per round must still reach full coverage, just more slowly than
+    pushpull (gossip.py test_pushpull_faster_than_pull analogue)."""
+    topo = build_aligned(seed=3, n=2048, n_slots=8, degree_law="regular")
+    pull = AlignedSimulator(topo=topo, n_msgs=4, mode="pull", seed=3)
+    res_pull = pull.run(64)
+    assert float(res_pull.coverage[-1]) > 0.99
+    pp = AlignedSimulator(topo=topo, n_msgs=4, mode="pushpull", seed=3)
+    res_pp = pp.run(64)
+    assert res_pp.rounds_to(0.99) <= res_pull.rounds_to(0.99)
