@@ -102,6 +102,10 @@ _SIM_INT_KEYS = {
     "fanout": "fanout",
     "rounds": "rounds",
     "prng_seed": "prng_seed",
+    # Socket mode: seconds between anti-entropy pulls (0 = off, the
+    # reference's behavior — its flood-once push loses every message
+    # generated before a connection existed, peer.cpp:297-318).
+    "anti_entropy_interval": "anti_entropy_interval",
 }
 _SIM_FLOAT_KEYS = {
     "er_p": "er_p",
@@ -152,6 +156,7 @@ class NetworkConfig:
         self.sir_beta = 0.3
         self.sir_gamma = 0.1
         self.prng_seed = 0
+        self.anti_entropy_interval = 0   # socket mode; 0 = off
         self._load_config()
         self._validate_config()
 
@@ -267,7 +272,7 @@ class NetworkConfig:
         if not is_valid_port(self.local_port):
             raise ConfigError(f"Invalid local_port: {self.local_port}")
         for k in ("n_peers", "n_messages", "avg_degree", "ba_m", "fanout",
-                  "rounds", "prng_seed"):
+                  "rounds", "prng_seed", "anti_entropy_interval"):
             if getattr(self, k) < 0:
                 raise ConfigError(f"{k} must be non-negative")
         if self.backend not in ("jax", "socket"):

@@ -41,7 +41,8 @@ class PeerNode:
                  powerlaw_alpha: float = 2.5, log_dir: str = ".",
                  rng: random.Random | None = None,
                  wire_format: str = "json",
-                 generation_delay_s: float = 0.0):
+                 generation_delay_s: float = 0.0,
+                 anti_entropy_interval: float = 0.0):
         self.ip = ip
         self.port = port
         self.seeds = seeds
@@ -56,6 +57,14 @@ class PeerNode:
         # deployment that wants every message everywhere starts
         # generating only once the membership has formed.
         self.generation_delay_s = generation_delay_s
+        # Anti-entropy pull (the half of push-pull the reference lacks,
+        # SURVEY §2-C11): every interval seconds, ask one random
+        # connected peer for its full message list — which is how a late
+        # joiner recovers messages flooded before it existed.  0 = off
+        # (reference behavior).  Wire-compatible: the request is a new
+        # "pull_request" type the reference would simply ignore, and the
+        # reply is ordinary "gossip" documents.
+        self.anti_entropy_interval = anti_entropy_interval
         self.rng = rng or random.Random()
         # "json" = reference byte-compatible unframed wire; "framed" =
         # length-prefixed robust mode (SURVEY.md §2-C7)
@@ -75,9 +84,33 @@ class PeerNode:
         # (ip, port) -> consecutive failed probes (reference pingStatus)
         self.ping_status: dict[tuple[str, int], int] = {}
         self.ping_lock = threading.Lock()
+        # Per-socket send locks: sendall() can release the GIL mid-write
+        # when the buffer fills, so two writer threads (broadcast relays,
+        # the generation loop, anti-entropy requests) would interleave
+        # bytes and permanently wedge an unframed-JSON stream.
+        self._send_locks: dict = {}        # socket -> Lock
+        self._send_locks_guard = threading.Lock()
 
         self._threads: list[threading.Thread] = []
         self.log = NodeLogger("peer", port, log_dir)
+
+    def _locked_send(self, sock, payload: dict) -> None:
+        """Serialize writers per socket (see _send_locks)."""
+        with self._send_locks_guard:
+            lock = self._send_locks.setdefault(sock, threading.Lock())
+        with lock:
+            self._send(sock, payload)
+
+    def _drop_send_lock(self, sock) -> None:
+        with self._send_locks_guard:
+            self._send_locks.pop(sock, None)
+
+    def _sleep_while_running(self, seconds: float) -> bool:
+        """Stop-responsive sleep; returns False if stopped meanwhile."""
+        deadline = time.time() + seconds
+        while self.running and time.time() < deadline:
+            time.sleep(0.05)
+        return self.running
 
     def _track(self, t: threading.Thread) -> None:
         """Track a daemon thread, pruning finished ones so long-running
@@ -101,8 +134,11 @@ class PeerNode:
 
         ok = self._bootstrap(wait_for_quorum, bootstrap_timeout)
 
-        for target in (self._accept_loop, self._ping_loop,
-                       self._message_generation_loop):
+        loops = [self._accept_loop, self._ping_loop,
+                 self._message_generation_loop]
+        if self.anti_entropy_interval > 0:
+            loops.append(self._anti_entropy_loop)
+        for target in loops:
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._threads.append(t)
@@ -118,6 +154,8 @@ class PeerNode:
                 except OSError:
                     pass
             self.connected_peers.clear()
+        with self._send_locks_guard:
+            self._send_locks.clear()
 
     def is_running(self) -> bool:
         return self.running
@@ -229,11 +267,48 @@ class PeerNode:
                 for msg in objs:
                     if msg.get("type") == "gossip":
                         self._on_gossip(Message.from_wire(msg), conn)
+                    elif msg.get("type") == "pull_request":
+                        self._serve_pull(conn, set(msg.get("have", ())))
         except OSError:
             pass
         finally:
+            self._drop_send_lock(conn)
             try:
                 conn.close()
+            except OSError:
+                pass
+
+    def _serve_pull(self, conn, have: set) -> None:
+        """Anti-entropy serve: send the requester every message NOT in
+        its ``have`` digest, as ordinary gossip documents (its dedup
+        still protects against races — the reference's messageList
+        check, peer.cpp:280-286).  The digest keeps steady-state pull
+        traffic at ~one request document per interval instead of
+        replaying the full history forever."""
+        with self.message_lock:
+            msgs = [t.msg for h, t in self.message_list.items()
+                    if h not in have]
+        for msg in msgs:
+            try:
+                self._locked_send(conn, msg.to_wire())
+            except OSError:
+                return
+
+    def _anti_entropy_loop(self) -> None:
+        while self.running:
+            if not self._sleep_while_running(self.anti_entropy_interval):
+                return
+            with self.peers_lock:
+                socks = list(self.connected_peers.values())
+            if not socks:
+                continue
+            sock = self.rng.choice(socks)
+            with self.message_lock:
+                have = list(self.message_list.keys())
+            try:
+                self._locked_send(sock, {"type": "pull_request",
+                                         "ip": self.ip, "port": self.port,
+                                         "have": have})
             except OSError:
                 pass
 
@@ -269,7 +344,7 @@ class PeerNode:
         sent = []
         for key, sock in targets:
             try:
-                self._send(sock, payload)
+                self._locked_send(sock, payload)
                 sent.append(key)
             except OSError:
                 pass
@@ -281,9 +356,8 @@ class PeerNode:
 
     # -- generation (peer.cpp:357-379) ---------------------------------
     def _message_generation_loop(self) -> None:
-        deadline = time.time() + self.generation_delay_s
-        while self.running and time.time() < deadline:
-            time.sleep(0.05)
+        if not self._sleep_while_running(self.generation_delay_s):
+            return
         counter = 0
         while self.running and counter < self.max_messages:
             msg = Message(
@@ -299,7 +373,8 @@ class PeerNode:
             self._broadcast(msg)
             self.log.log(f"Generated message: {msg.content} #{counter}")
             counter += 1
-            time.sleep(self.message_interval)
+            if not self._sleep_while_running(self.message_interval):
+                return
 
     # -- liveness (peer.cpp:320-355, 381-405) --------------------------
     def _probe(self, ip: str, port: int) -> bool:
@@ -316,7 +391,8 @@ class PeerNode:
 
     def _ping_loop(self) -> None:
         while self.running:
-            time.sleep(min(self.ping_interval, 1.0))
+            if not self._sleep_while_running(min(self.ping_interval, 1.0)):
+                return
             with self.peers_lock:
                 keys = list(self.connected_peers.keys())
             dead = []
@@ -332,18 +408,16 @@ class PeerNode:
                             dead.append(key)
             for key in dead:
                 self._handle_dead_peer(*key)
-            # pace the full sweep at ping_interval (loop granularity 1 s
-            # so stop() stays responsive)
-            for _ in range(int(self.ping_interval)):
-                if not self.running:
-                    return
-                time.sleep(1.0)
+            # pace the full sweep at ping_interval
+            if not self._sleep_while_running(self.ping_interval):
+                return
 
     def _handle_dead_peer(self, ip: str, port: int) -> None:
         self.log.log(f"Peer declared dead: {ip}:{port}")
         with self.peers_lock:
             sock = self.connected_peers.pop((ip, port), None)
         if sock is not None:
+            self._drop_send_lock(sock)
             try:
                 sock.close()
             except OSError:

@@ -44,6 +44,7 @@ class Peer:
                 max_missed_pings=cfg.get_max_missed_pings(),
                 powerlaw_alpha=cfg.powerlaw_alpha,
                 wire_format=cfg.wire_format,
+                anti_entropy_interval=cfg.anti_entropy_interval,
             )
         else:
             from p2p_gossipprotocol_tpu.sim import Simulator

@@ -164,3 +164,39 @@ def test_connections_survive_silence(tmp_path):
         for p in nodes:
             p.stop()
         seed.stop()
+
+
+def test_anti_entropy_recovers_late_joiner(tmp_path):
+    """A peer that joins AFTER messages were flooded recovers them via
+    anti-entropy pulls — the capability the reference's flood-once push
+    fundamentally lacks (old rumors are never re-sent,
+    peer.cpp:297-318)."""
+    seed = SeedNode("127.0.0.1", BASE + 70, log_dir=str(tmp_path))
+    seed.start()
+    seeds = [PeerInfo("127.0.0.1", BASE + 70)]
+    early = PeerNode("127.0.0.1", BASE + 71, seeds,
+                     message_interval=0.1, max_messages=3,
+                     powerlaw_alpha=16.0, log_dir=str(tmp_path))
+    late = None
+    try:
+        assert early.start(bootstrap_timeout=10.0)
+        # early generates ALL its messages before late exists
+        assert _wait(lambda: len(early.message_list) == 3, timeout=15.0)
+
+        late = PeerNode("127.0.0.1", BASE + 72, seeds,
+                        message_interval=0.1, max_messages=0,
+                        powerlaw_alpha=16.0, log_dir=str(tmp_path),
+                        anti_entropy_interval=0.5)
+        assert late.start(bootstrap_timeout=10.0)
+        assert _wait(lambda: ("127.0.0.1", BASE + 71)
+                     in late.connected_peers, timeout=10.0)
+
+        def late_has_all():
+            with late.message_lock:
+                return len(late.message_list) == 3
+        assert _wait(late_has_all, timeout=20.0)
+    finally:
+        early.stop()
+        if late is not None:
+            late.stop()
+        seed.stop()
