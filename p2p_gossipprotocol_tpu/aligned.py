@@ -19,9 +19,13 @@ that peers sharing a row share their slot-d neighbor *row* (documented;
 statistically irrelevant for dissemination — validated against the exact
 engine in tests/test_aligned.py).
 
-Messages are bit-packed 32-per-int32-word, so the whole network state is
-one [R, 128] word array and dedup-by-OR (the reference's messageList
-check, peer.cpp:280-286) is a single bitwise op.
+Messages are bit-packed 32-per-int32-word across W planes, so the whole
+network state is one [W, R, 128] word array and dedup-by-OR (the
+reference's messageList check, peer.cpp:280-286) is a single bitwise op.
+W scales with the configured message count (the reference's per-peer
+rumor universe, peer.cpp:357-366) — the engine is no longer capped at 32
+messages; the practical ceiling is VMEM (see the rowblk check in
+AlignedSimulator.__post_init__) and HBM for the state planes.
 """
 
 from __future__ import annotations
@@ -39,7 +43,25 @@ from p2p_gossipprotocol_tpu.ops.aligned_kernel import (LANES, gossip_pass,
                                                        liveness_pass,
                                                        neighbor_ids)
 
-MAX_PACKED_MSGS = 32
+WORD_BITS = 32
+# VMEM ceiling for the gossip kernel: the y and acc blocks are
+# int32[W, rowblk, 128] each, double-buffered — keep W * rowblk under
+# this budget (4096 * 128 * 4 B * 2 arrays * 2 buffers ≈ 8 MiB of the
+# ~16 MiB core VMEM).  build_aligned picks rowblk accordingly.
+MAX_WORDS_X_ROWBLK = 4096
+
+
+def n_msg_words(n_msgs: int) -> int:
+    """Message planes needed for ``n_msgs`` bit-packed rumors."""
+    return -(-n_msgs // WORD_BITS)
+
+
+def mask_words(n_bits: int, n_planes: int) -> jax.Array:
+    """int32[n_planes] with the low ``n_bits`` set across the planes
+    (plane w holds messages [32w, 32w+32))."""
+    k = np.clip(n_bits - WORD_BITS * np.arange(n_planes), 0, WORD_BITS)
+    vals = ((np.uint64(1) << k.astype(np.uint64)) - 1).astype(np.uint32)
+    return jnp.asarray(vals.view(np.int32))
 
 
 @struct.dataclass
@@ -69,9 +91,14 @@ class AlignedTopology:
 def build_aligned(seed: int, n: int, n_slots: int = 16,
                   degree_law: str = "regular",
                   powerlaw_alpha: float = 2.5,
-                  rowblk: int = 512, n_shards: int = 1) -> AlignedTopology:
+                  rowblk: int = 512, n_shards: int = 1,
+                  n_msgs: int = 1) -> AlignedTopology:
     """Sample an aligned overlay for ``n`` peers with ``n_slots`` in-edge
     slots per peer.
+
+    ``n_msgs`` only influences the row-block size: the gossip kernel
+    keeps int32[W, rowblk, 128] blocks resident in VMEM, so wide message
+    sets shrink the block (W * rowblk <= MAX_WORDS_X_ROWBLK).
 
     degree_law:
       * ``regular``  — every peer listens on all slots (ER-like, average
@@ -86,6 +113,9 @@ def build_aligned(seed: int, n: int, n_slots: int = 16,
     """
     if n_slots > 127:
         raise ValueError("n_slots must fit int8 gating (<= 127)")
+    rowblk = min(rowblk,
+                 max(8, (MAX_WORDS_X_ROWBLK // n_msg_words(n_msgs))
+                     // 8 * 8))
     rng = np.random.default_rng(seed)
     rows0 = max(1, -(-n // LANES))
     # Padding peers are black holes (they listen to no one, so slots
@@ -153,13 +183,14 @@ def build_aligned(seed: int, n: int, n_slots: int = 16,
 class AlignedState:
     """Bit-packed network state.  Maps to the edge engine's GossipState
     (state.py:34-51): ``seen_w``/``frontier_w`` pack the bool[peers, msgs]
-    planes 32-per-word, ``alive_b``/``byz_w`` are the liveness and
-    adversary masks, ``strikes`` the per-slot consecutive-dead counters
-    (the vectorized 3-strike rule, reference peer.cpp:335-339) — present
+    planes 32-per-word over W int32 planes (message m lives at bit m%32 of
+    plane m//32), ``alive_b``/``byz_w`` are the liveness and adversary
+    masks, ``strikes`` the per-slot consecutive-dead counters (the
+    vectorized 3-strike rule, reference peer.cpp:335-339) — present
     only when liveness is enabled (None otherwise, an empty pytree leaf)."""
 
-    seen_w: jax.Array      # int32[R, 128]  bit j = peer has rumor j
-    frontier_w: jax.Array  # int32[R, 128]  bit j = first heard last round
+    seen_w: jax.Array      # int32[W, R, 128]  bit j of plane w = rumor 32w+j
+    frontier_w: jax.Array  # int32[W, R, 128]  first heard last round
     alive_b: jax.Array     # bool [R, 128]  liveness mask
     byz_w: jax.Array       # int32[R, 128]  -1 = byzantine peer, 0 honest
     strikes: jax.Array | None   # int8[D, R, 128] or None
@@ -214,7 +245,8 @@ def churn_rows(key: jax.Array, grows: jax.Array, alive_b: jax.Array,
 @dataclass
 class AlignedSimulator:
     """Same surface as sim.Simulator (step / run / run_to_coverage, same
-    metric dict, churn + liveness/rewire + byzantine), flood-push or
+    metric dict, churn + liveness/rewire + byzantine), flood-push,
+    bounded-fanout rumor mongering (``fanout > 0``), or
     push+anti-entropy-pull, at HBM-bandwidth speed.
 
     Liveness semantics mirror liveness.strike_and_rewire: a slot whose
@@ -228,6 +260,7 @@ class AlignedSimulator:
     topo: AlignedTopology
     n_msgs: int = 16
     mode: str = "push"           # push | pull | pushpull
+    fanout: int = 0              # 0 = flood; else slots listened per round
     churn: ChurnConfig = None    # type: ignore[assignment]
     byzantine_fraction: float = 0.0
     n_honest_msgs: int | None = None   # None → all columns honest
@@ -236,11 +269,13 @@ class AlignedSimulator:
     interpret: bool | None = None   # None -> interpret unless on TPU
 
     def __post_init__(self):
-        if not 0 < self.n_msgs <= MAX_PACKED_MSGS:
-            raise ValueError(
-                f"aligned engine packs <= {MAX_PACKED_MSGS} messages")
+        if self.n_msgs <= 0:
+            raise ValueError("n_msgs must be positive")
+        self.n_words = n_msg_words(self.n_msgs)
         if self.mode not in ("push", "pull", "pushpull"):
             raise ValueError(f"Unknown gossip mode: {self.mode}")
+        if self.fanout < 0:
+            raise ValueError("fanout must be >= 0 (0 = flood)")
         if not 0 < self.max_strikes <= 126:
             # strikes are int8 clamped at max_strikes + 1; 127 would wrap
             # and silently disable eviction (the edge engine's int32
@@ -262,6 +297,25 @@ class AlignedSimulator:
                 f"and an 8-aligned row block (this overlay: "
                 f"{self.topo.rows} rows, rowblk {self.topo.rowblk}) — "
                 "use the edge engine, a larger overlay, or fewer shards")
+        if not self.interpret and \
+                self.n_words * self.topo.rowblk > MAX_WORDS_X_ROWBLK:
+            # The kernel keeps int32[W, rowblk, 128] y/acc blocks resident
+            # in VMEM; an over-budget combination compile-errors deep in
+            # Mosaic.  Fail at construction with the fix spelled out —
+            # and when no row block can help (build_aligned floors the
+            # block at 8 sublanes), state the hard ceiling instead of
+            # advising a rebuild that would fail the same way.
+            hard_cap = (MAX_WORDS_X_ROWBLK // 8) * WORD_BITS
+            if self.n_words * 8 > MAX_WORDS_X_ROWBLK:
+                raise ValueError(
+                    f"{self.n_msgs} messages exceed the aligned engine's "
+                    f"hard ceiling of {hard_cap} (the VMEM row block "
+                    "bottoms out at 8 sublanes) — use the edge engine")
+            raise ValueError(
+                f"{self.n_msgs} messages ({self.n_words} planes) with row "
+                f"block {self.topo.rowblk} exceed the kernel's VMEM "
+                f"budget — rebuild the overlay with build_aligned(..., "
+                f"n_msgs={self.n_msgs}) (shrinks the row block)")
         self._n_honest = (self.n_honest_msgs
                           if self.n_honest_msgs is not None else self.n_msgs)
         if not 0 < self._n_honest <= self.n_msgs:
@@ -270,10 +324,9 @@ class AlignedSimulator:
         # churn no neighbor is ever observed dead, so the pass is skipped
         # statically and the strike plane is never allocated.
         self._liveness = self.churn.rate > 0.0 or self.churn.revive > 0.0
-        self._honest_mask = jnp.int32(-1 if self._n_honest >= 32
-                                      else (1 << self._n_honest) - 1)
-        self._junk_mask = (jnp.int32(-1 if self.n_msgs >= 32
-                                     else (1 << self.n_msgs) - 1)
+        # Per-plane masks, int32[W]; broadcast as mask[:, None, None].
+        self._honest_mask = mask_words(self._n_honest, self.n_words)
+        self._junk_mask = (mask_words(self.n_msgs, self.n_words)
                            & ~self._honest_mask)
         self._run_cache: dict = {}
         self._loop_cache: dict = {}
@@ -303,16 +356,17 @@ class AlignedSimulator:
         src = honest_idx[pos]
         place = jnp.arange(self.n_msgs) < self._n_honest
         # Seed words in uint32 with scatter-ADD: distinct message bits add
-        # like OR (so colliding sources keep every rumor), and bit 31
-        # survives (an int32 `1 << 31` would wrap negative and be dropped
-        # by a max-combiner).  Bitcast back to the engine's int32 words.
+        # like OR (so colliding sources keep every rumor — every message
+        # is a distinct (plane, bit) pair), and bit 31 survives (an int32
+        # `1 << 31` would wrap negative and be dropped by a max-combiner).
+        # Bitcast back to the engine's int32 words.
+        m = jnp.arange(self.n_msgs)
         bits = jnp.where(
-            place, jnp.uint32(1) << jnp.arange(self.n_msgs,
-                                               dtype=jnp.uint32), 0)
-        bits_u = jnp.zeros(rows * LANES, jnp.uint32).at[
-            jnp.where(place, src, 0)].add(bits)
+            place, jnp.uint32(1) << (m % WORD_BITS).astype(jnp.uint32), 0)
+        bits_u = jnp.zeros((self.n_words, rows * LANES), jnp.uint32).at[
+            m // WORD_BITS, jnp.where(place, src, 0)].add(bits)
         seen = jax.lax.bitcast_convert_type(
-            bits_u, jnp.int32).reshape(rows, LANES)
+            bits_u, jnp.int32).reshape(self.n_words, rows, LANES)
         strikes = (jnp.zeros((self.topo.n_slots, rows, LANES), jnp.int8)
                    if self._liveness else None)
         return AlignedState(seen_w=seen, frontier_w=seen, alive_b=valid_b,
@@ -427,8 +481,8 @@ def aligned_coverage(sim: AlignedSimulator, state: AlignedState,
     alive_w = jnp.where(state.alive_b, jnp.int32(-1), jnp.int32(0))
     ok_w = alive_w & ~state.byz_w & topo.valid_w
     n_ok = max(int(jax.device_get(_popcount_sum(ok_w))) >> 5, 1)
-    hits = int(jax.device_get(
-        _popcount_sum(state.seen_w & ok_w & sim._honest_mask)))
+    hits = int(jax.device_get(_popcount_sum(
+        state.seen_w & ok_w[None] & sim._honest_mask[:, None, None])))
     return hits / (n_ok * sim._n_honest)
 
 
@@ -444,12 +498,17 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
       * ``t_off``  — this caller's first row-block index (offsets the
         kernel's per-slot block rolls);
       * ``gather`` — identity, or ``all_gather`` over the mesh axis (makes
-        the row-permuted sender/alive words global before the kernels);
+        the row-permuted sender/alive words global before the kernels;
+        must gather the ROWS axis, which is ndim-2: axis 0 of the 2D
+        alive words, axis 1 of the 3D message planes);
       * ``reduce`` — identity, or ``psum`` (metric reduction).
     Everything else — churn, strikes/rewire, byzantine, gossip passes,
     metrics — is this one code path, so the engines cannot drift."""
+    def prow(x):   # apply the row permutation on the rows (ndim-2) axis
+        return jnp.take(x, topo.perm, axis=x.ndim - 2)
+
     valid_b = topo.valid_w != 0
-    key, k_churn, k_rew, k_pull = jax.random.split(state.key, 4)
+    key, k_churn, k_rew, k_pull, k_fan = jax.random.split(state.key, 5)
 
     alive_b = state.alive_b
     if sim.churn.rate > 0.0 or sim.churn.revive > 0.0:
@@ -461,7 +520,7 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
     n_evict = jnp.int32(0)
     rolls_off = topo.rolls + t_off
     if sim._liveness:
-        y_alive = jnp.take(gather(alive_w), topo.perm, axis=0)
+        y_alive = prow(gather(alive_w))
         rand = row_randint(k_rew, grows, (topo.n_slots, LANES),
                            0, LANES, jnp.int8).transpose(1, 0, 2)
         colidx, strikes, evict8 = liveness_pass(
@@ -475,17 +534,27 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
     if sim._n_honest < sim.n_msgs:
         # Byzantine injection (models/byzantine.py:24-38): junk bits
         # enter every byzantine peer's seen+frontier each round.
-        inject = state.byz_w & sim._junk_mask & ~seen_w
+        inject = state.byz_w[None] & sim._junk_mask[:, None, None] & ~seen_w
         seen_w = seen_w | inject
         frontier_w = frontier_w | inject
 
     if sim.mode in ("push", "pushpull"):
         # Dead peers don't send; byzantine peers never relay (suppression,
         # models/gossip.py:50-58) — both masked at the source words.
-        send = frontier_w & alive_w & ~state.byz_w
-        y = jnp.take(gather(send), topo.perm, axis=0)
+        send = frontier_w & alive_w[None] & ~state.byz_w[None]
+        y = prow(gather(send))
+        if sim.fanout > 0:
+            # Rumor mongering: each peer listens on a random fanout-slot
+            # window this round (shard-invariant per-row draw, same
+            # discipline as the pull contact below).
+            u = row_randint(k_fan, grows, (LANES,), 0, 1 << 30, jnp.int32)
+            deg32 = topo.deg.astype(jnp.int32)
+            shift = (u % jnp.maximum(deg32, 1)).astype(jnp.int8)
+        else:
+            shift = None
         recv = gossip_pass(y, topo.colidx, topo.deg, rolls_off,
-                           topo.subrolls, pull=False, rowblk=topo.rowblk,
+                           topo.subrolls, pull=False, fanout=sim.fanout,
+                           shift=shift, rowblk=topo.rowblk,
                            interpret=sim.interpret)
     else:                       # pure anti-entropy pull
         recv = jnp.zeros_like(seen_w)
@@ -493,8 +562,7 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
         # Anti-entropy: each peer pulls one random slot's neighbor's
         # full seen-set; dead/byzantine neighbors serve nothing
         # (gossip.py pull_round's alive[nbr] & ~byzantine[nbr]).
-        ys = jnp.take(gather(state.seen_w & alive_w & ~state.byz_w),
-                      topo.perm, axis=0)
+        ys = prow(gather(state.seen_w & alive_w[None] & ~state.byz_w[None]))
         u = row_randint(k_pull, grows, (LANES,), 0, 1 << 30, jnp.int32)
         deg32 = topo.deg.astype(jnp.int32)
         delta = (u % jnp.maximum(deg32, 1)).astype(jnp.int8)
@@ -506,7 +574,7 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
                                   interpret=sim.interpret)
 
     # Dead peers don't receive (the link is gone — gossip.py:_advance).
-    recv = recv & topo.valid_w & alive_w
+    recv = recv & topo.valid_w[None] & alive_w[None]
     new = recv & ~seen_w
     seen = seen_w | new
     # In this engine deliveries == frontier bits by construction (every
@@ -518,7 +586,8 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
     # bits to popcount(ok_w), hence the >> 5 peer count.
     ok_w = alive_w & ~state.byz_w & topo.valid_w
     n_ok = jnp.maximum(reduce(_popcount_sum(ok_w)) >> 5, 1)
-    coverage = (reduce(_popcount_sum(seen & ok_w & sim._honest_mask))
+    coverage = (reduce(_popcount_sum(
+        seen & ok_w[None] & sim._honest_mask[:, None, None]))
                 .astype(jnp.float32)
                 / (n_ok.astype(jnp.float32) * sim._n_honest))
     live = reduce(_popcount_sum(alive_w & topo.valid_w)) >> 5

@@ -1,0 +1,200 @@
+"""SIR epidemic model on the hardware-aligned overlay — the scale path
+for BASELINE config 3 (the edges engine's SIRSimulator hits the same
+~100k-peer gather wall as its gossip sibling; this runs the identical
+compartment semantics at the aligned engine's 1M-10M-peer scale).
+
+Semantics mirror models/sir.py:sir_round exactly:
+  * infection pressure = number of transmitting (infected AND alive)
+    in-neighbors, here one SUM-accumulated pallas pass over the aligned
+    overlay's slots (ops/aligned_kernel.py:count_pass);
+  * susceptible -> infected with p = 1 - (1-beta)^pressure;
+  * infected -> recovered with probability gamma per round (dead peers
+    included — recovery is biological, not network state, matching
+    models/sir.py:29);
+  * churn masks contacts the same way the gossip engines' does.
+
+The reference has no epidemic model — its gossip IS the SI special case
+(seen = infected, gamma = 0; peer.cpp:280-286) — so like the edges SIR
+engine this consumes the ``sir_beta``/``sir_gamma`` config keys the
+reference-parity config system exposes.
+
+Every random draw (churn, infection, recovery) is keyed on the GLOBAL
+row id via fold_in (aligned.row_uniform), so the sharded counterpart
+(parallel/aligned_sharded.py:AlignedShardedSIRSimulator) is bitwise
+equal to this engine — the same determinism contract as the gossip
+pair.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from p2p_gossipprotocol_tpu.aligned import (AlignedTopology, churn_rows,
+                                            row_uniform)
+from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+from p2p_gossipprotocol_tpu.ops.aligned_kernel import LANES, count_pass
+
+
+@struct.dataclass
+class AlignedSIRState:
+    """Compartments as two bool planes on the [rows, 128] peer grid
+    (S = ~infected & ~recovered; the int8 0/1/2 compartment of
+    state.py:SIRState unpacked into masks the VPU consumes directly)."""
+
+    inf_b: jax.Array     # bool[R, 128]
+    rec_b: jax.Array     # bool[R, 128]
+    alive_b: jax.Array   # bool[R, 128]
+    key: jax.Array
+    round: jax.Array
+    n_peers: int = struct.field(pytree_node=False)
+
+
+def _count(mask_b: jax.Array, valid_b: jax.Array) -> jax.Array:
+    return jnp.sum((mask_b & valid_b).astype(jnp.int32), dtype=jnp.int32)
+
+
+@dataclass
+class AlignedSIRSimulator:
+    """Same surface as sim.SIRSimulator (step / run / SIRResult census,
+    beta/gamma/n_seeds/churn knobs) on the aligned overlay."""
+
+    topo: AlignedTopology
+    beta: float = 0.3
+    gamma: float = 0.1
+    n_seeds: int = 1
+    churn: ChurnConfig = None    # type: ignore[assignment]
+    seed: int = 0
+    interpret: bool | None = None
+
+    def __post_init__(self):
+        if not 0.0 <= self.beta <= 1.0:
+            raise ValueError("sir_beta must be in [0, 1]")
+        if not 0.0 <= self.gamma <= 1.0:
+            raise ValueError("sir_gamma must be in [0, 1]")
+        if self.churn is None:
+            self.churn = ChurnConfig()
+        if self.interpret is None:
+            self.interpret = jax.default_backend() not in ("tpu", "axon")
+        if not self.interpret and (self.topo.rows < 8
+                                   or self.topo.rowblk % 8):
+            raise ValueError(
+                f"aligned SIR on TPU needs >= 8 rows of {LANES} peers and "
+                f"an 8-aligned row block (this overlay: {self.topo.rows} "
+                f"rows, rowblk {self.topo.rowblk})")
+        self._scan_cache: dict = {}
+
+    # ------------------------------------------------------------------
+    def init_state(self) -> AlignedSIRState:
+        """Seed infections spread evenly over the peer population (the
+        deterministic analogue of init_sir_state's uniform choice)."""
+        topo = self.topo
+        n = topo.n_peers
+        n_seeds = max(1, min(self.n_seeds, n))
+        pos = (np.arange(n_seeds, dtype=np.int64)
+               * max(n // n_seeds, 1)) % n
+        inf = np.zeros(topo.rows * LANES, bool)
+        inf[pos] = True
+        return AlignedSIRState(
+            inf_b=jnp.asarray(inf.reshape(topo.rows, LANES)),
+            rec_b=jnp.zeros((topo.rows, LANES), bool),
+            alive_b=topo.valid_w != 0,
+            key=jax.random.PRNGKey(self.seed),
+            round=jnp.int32(0),
+            n_peers=n,
+        )
+
+    # ------------------------------------------------------------------
+    def step(self, state: AlignedSIRState
+             ) -> tuple[AlignedSIRState, dict]:
+        grows = jnp.arange(self.topo.rows, dtype=jnp.int32)
+        return aligned_sir_round(self, state, self.topo, grows=grows,
+                                 t_off=jnp.int32(0),
+                                 gather=lambda x: x, reduce=lambda x: x)
+
+    # ------------------------------------------------------------------
+    def run(self, rounds: int, state: AlignedSIRState | None = None,
+            warmup: bool = False):
+        """Fixed-round scan; returns the shared :class:`sim.SIRResult`.
+
+        ``warmup=True`` executes the compiled program once untimed first
+        so ``wall_s`` excludes compile + one-time program upload — the
+        same benchmark-parity flag as every other run() on the scale
+        path (round-2 advisor finding)."""
+        import time as _time
+
+        from p2p_gossipprotocol_tpu.sim import SIRResult
+
+        state = self.init_state() if state is None else state
+        if rounds not in self._scan_cache:
+            def scanned(st):
+                def body(carry, _):
+                    s, metrics = self.step(carry)
+                    return s, metrics
+                return jax.lax.scan(body, st, None, length=rounds)
+            self._scan_cache[rounds] = jax.jit(scanned)
+        if warmup:
+            w_state, _ = self._scan_cache[rounds](state)
+            int(jax.device_get(w_state.round))
+        t0 = _time.perf_counter()
+        state, ys = self._scan_cache[rounds](state)
+        int(jax.device_get(state.round))   # forces completion
+        wall = _time.perf_counter() - t0
+        return SIRResult(
+            state=state, topo=self.topo,
+            susceptible=np.asarray(ys["susceptible"]),
+            infected=np.asarray(ys["infected"]),
+            recovered=np.asarray(ys["recovered"]),
+            new_infections=np.asarray(ys["new_infections"]),
+            live_peers=np.asarray(ys["live_peers"]),
+            wall_s=wall,
+        )
+
+
+def aligned_sir_round(sim: AlignedSIRSimulator, state: AlignedSIRState,
+                      topo: AlignedTopology, *, grows: jax.Array,
+                      t_off: jax.Array, gather, reduce
+                      ) -> tuple[AlignedSIRState, dict]:
+    """THE SIR round, shared by the single-chip engine and
+    AlignedShardedSIRSimulator — same grows/t_off/gather/reduce seams as
+    aligned.aligned_round (see its docstring)."""
+    valid_b = topo.valid_w != 0
+    key, k_churn, k_u = jax.random.split(state.key, 3)
+
+    alive_b = state.alive_b
+    if sim.churn.rate > 0.0 or sim.churn.revive > 0.0:
+        alive_b = churn_rows(k_churn, grows, alive_b, valid_b,
+                             state.round, sim.churn)
+
+    transmitting = jnp.where(state.inf_b & alive_b, jnp.int32(-1),
+                             jnp.int32(0))
+    y = jnp.take(gather(transmitting), topo.perm, axis=0)
+    pressure = count_pass(y, topo.colidx, topo.deg, topo.rolls + t_off,
+                          topo.subrolls, rowblk=topo.rowblk,
+                          interpret=sim.interpret)
+    p_infect = 1.0 - jnp.power(jnp.float32(1.0 - sim.beta),
+                               pressure.astype(jnp.float32))
+    u = row_uniform(k_u, grows, (2, LANES))
+    u_inf, u_rec = u[:, 0], u[:, 1]
+    sus_b = ~state.inf_b & ~state.rec_b & valid_b
+    new_inf = sus_b & alive_b & (u_inf < p_infect)
+    recovers = state.inf_b & (u_rec < sim.gamma)
+    inf_b = (state.inf_b | new_inf) & ~recovers
+    rec_b = state.rec_b | recovers
+
+    metrics = {
+        "susceptible": reduce(_count(~inf_b & ~rec_b, valid_b)),
+        "infected": reduce(_count(inf_b, valid_b)),
+        "recovered": reduce(_count(rec_b, valid_b)),
+        "new_infections": reduce(_count(new_inf, valid_b)),
+        "live_peers": reduce(_count(alive_b, valid_b)),
+    }
+    state = AlignedSIRState(inf_b=inf_b, rec_b=rec_b, alive_b=alive_b,
+                            key=key, round=state.round + 1,
+                            n_peers=state.n_peers)
+    return state, metrics

@@ -93,12 +93,11 @@ def _run_jax(cfg: NetworkConfig, args) -> int:
     with metrics_lib.profile(args.profile_dir):
         if cfg.mode == "sir":
             if args.engine == "aligned":
-                print("Error: --engine aligned does not run the SIR model "
-                      "(use --engine edges)", file=sys.stderr)
-                return 1
+                return _run_jax_sir_aligned(cfg, args, rounds, metrics_lib)
             if args.mesh_devices > 1:
-                print("Error: --mesh-devices does not apply to the SIR "
-                      "model (single-device only)", file=sys.stderr)
+                print("Error: --mesh-devices with the SIR model needs "
+                      "--engine aligned (the edges SIR engine is "
+                      "single-device)", file=sys.stderr)
                 return 1
             return _run_jax_sir(cfg, args, rounds, metrics_lib)
         if args.engine == "aligned":
@@ -150,6 +149,62 @@ def _run_jax_sir(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
               f"beta={sim.beta:g}, gamma={sim.gamma:g}, "
               f"{int(sim.topo.n_edges())} edges")
     res = sim.run(rounds)
+    _report_sir(res, n_peers=sim.topo.n_peers, engine="edges", args=args,
+                metrics_lib=metrics_lib)
+    return 0
+
+
+def _run_jax_sir_aligned(cfg: NetworkConfig, args, rounds,
+                         metrics_lib) -> int:
+    """BASELINE config 3 on the scale path: the aligned overlay's SIR
+    engine (aligned_sir.py), single-chip or sharded over --mesh-devices."""
+    from p2p_gossipprotocol_tpu.aligned import build_aligned
+    from p2p_gossipprotocol_tpu.aligned_sir import AlignedSIRSimulator
+    from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+
+    clamps: list[str] = []
+    try:
+        n, law, n_slots = _resolve_aligned_overlay(cfg, args, clamps)
+    except ValueError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    for c in clamps:
+        print(f"Warning: --engine aligned clamped {c}", file=sys.stderr)
+    n_shards = max(1, args.mesh_devices)
+    try:
+        topo = build_aligned(seed=cfg.prng_seed, n=n, n_slots=n_slots,
+                             degree_law=law,
+                             powerlaw_alpha=cfg.powerlaw_alpha,
+                             n_shards=n_shards)
+        kw = dict(topo=topo, beta=cfg.sir_beta, gamma=cfg.sir_gamma,
+                  churn=ChurnConfig(rate=cfg.churn_rate),
+                  seed=cfg.prng_seed)
+        if n_shards > 1:
+            from p2p_gossipprotocol_tpu.parallel import (
+                AlignedShardedSIRSimulator, make_mesh)
+
+            sim = AlignedShardedSIRSimulator(mesh=make_mesh(n_shards), **kw)
+            engine = f"aligned-sharded-{n_shards}"
+        else:
+            sim = AlignedSIRSimulator(**kw)
+            engine = "aligned"
+    except ValueError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    if not args.quiet:
+        print(f"[jax/sir] simulating {n} peers, beta={cfg.sir_beta:g}, "
+              f"gamma={cfg.sir_gamma:g}, {topo.n_slots} slots/peer, "
+              f"engine={engine}")
+    res = sim.run(rounds)
+    _report_sir(res, n_peers=n, engine=engine, args=args,
+                metrics_lib=metrics_lib, clamps=clamps)
+    return 0
+
+
+def _report_sir(res, *, n_peers, engine, args, metrics_lib,
+                clamps=None) -> None:
+    """Shared SIR census printout + JSONL + summary line (both engines
+    return the same SIRResult)."""
     if not args.quiet:
         for i in range(len(res.infected)):
             print(f"round {i + 1:4d}  S={res.susceptible[i]:8d}  "
@@ -167,13 +222,13 @@ def _run_jax_sir(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
             "live_peers": int(res.live_peers[i]),
         } for i in range(len(res.infected))]
         with open(args.metrics_jsonl, "w") as fp:
-            metrics_lib.emit_jsonl(rows, fp, n_peers=sim.topo.n_peers,
-                                   mode="sir", engine="edges")
+            metrics_lib.emit_jsonl(rows, fp, n_peers=n_peers,
+                                   mode="sir", engine=engine)
     extinction = res.rounds_to_extinction()
-    print(json.dumps({
-        "n_peers": sim.topo.n_peers,
+    out = {
+        "n_peers": n_peers,
         "mode": "sir",
-        "engine": "edges",
+        "engine": engine,
         "rounds_run": int(len(res.infected)),
         "final_susceptible": int(res.susceptible[-1]),
         "final_infected": int(res.infected[-1]),
@@ -183,8 +238,43 @@ def _run_jax_sir(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
         "rounds_to_extinction": extinction,
         "total_new_infections": int(res.new_infections.sum()),
         "wall_s": float(res.wall_s),
-    }))
-    return 0
+    }
+    if clamps:
+        out["clamped"] = clamps
+    print(json.dumps(out))
+
+
+def _resolve_aligned_overlay(cfg: NetworkConfig, args,
+                             clamps: list[str]) -> tuple[int, str, int]:
+    """(n_peers, degree_law, n_slots) for the aligned overlay family,
+    shared by the gossip and SIR aligned paths.  Engine ceilings
+    (aligned.py: int8 slot index → n_slots ≤ 127) and model substitutions
+    are appended to ``clamps`` — never silently weaken the configured
+    scenario (the parsed-then-quietly-altered defect class, SURVEY
+    §2-C2): every entry is printed on stderr and lands in the result
+    line.  Raises ValueError for an overlay the family cannot express."""
+    n = args.n_peers or cfg.n_peers or len(cfg.seed_nodes)
+    if cfg.graph in ("reference", "powerlaw"):
+        law = "powerlaw"
+    elif cfg.graph == "er":
+        law = "regular"        # ER == uniform slot count, the direct analogue
+    elif cfg.graph == "ba":
+        # Preferential attachment has no aligned analogue; the heavy
+        # tail is what matters for dissemination/epidemic dynamics, so
+        # substitute the power-law degree family — surfaced, not silent.
+        law = "powerlaw"
+        clamps.append("graph ba -> aligned power-law degree family "
+                      "(preferential attachment has no aligned analogue)")
+    else:
+        raise ValueError(
+            f"--engine aligned supports reference/powerlaw/er/ba "
+            f"overlays, not {cfg.graph!r} (use --engine edges)")
+    n_slots = cfg.avg_degree or 16
+    if n_slots > 127:
+        clamps.append(f"avg_degree {n_slots} -> 127 "
+                      "(aligned engine slot index is int8)")
+        n_slots = 127
+    return n, law, n_slots
 
 
 def _run_jax_aligned(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
@@ -192,70 +282,53 @@ def _run_jax_aligned(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
                                                 build_aligned)
     from p2p_gossipprotocol_tpu.liveness import ChurnConfig
 
-    n = args.n_peers or cfg.n_peers or len(cfg.seed_nodes)
     if cfg.mode not in ("push", "pull", "pushpull"):
-        print(f"Error: --engine aligned supports push/pull/pushpull, "
+        print(f"Error: --engine aligned supports push/pull/pushpull/sir, "
               f"not {cfg.mode!r}", file=sys.stderr)
         return 1
-    if cfg.fanout:
-        # Never silently weaken the configured scenario: the aligned
-        # engine floods all degree slots (the reference's broadcast);
-        # bounded-fanout rumor mongering needs the exact engine.
-        print("Error: --engine aligned does not support fanout "
-              "(use --engine edges, or drop fanout for flood)",
-              file=sys.stderr)
-        return 1
     mode = cfg.mode
-    if cfg.graph in ("reference", "powerlaw"):
-        law = "powerlaw"
-    elif cfg.graph == "er":
-        law = "regular"        # ER == uniform slot count, the direct analogue
-    else:
-        print(f"Error: --engine aligned supports "
-              f"reference/powerlaw/er overlays, not {cfg.graph!r} "
-              "(use --engine edges for ba)", file=sys.stderr)
-        return 1
-    # Engine ceilings (aligned.py: 32-message pack cap, int8 slot index →
-    # n_slots ≤ 127).  Never silently weaken the configured scenario
-    # (the parsed-then-quietly-altered defect class, SURVEY §2-C2):
-    # surface every clamp on stderr and in the result line.
     clamps: list[str] = []
-    n_slots = cfg.avg_degree or 16
-    if n_slots > 127:
-        clamps.append(f"avg_degree {n_slots} -> 127 "
-                      "(aligned engine slot index is int8)")
-        n_slots = 127
+    try:
+        n, law, n_slots = _resolve_aligned_overlay(cfg, args, clamps)
+    except ValueError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    # The CLI bounds the bit-packed message planes at 64 words = 2048
+    # messages, far past every BASELINE config.
+    max_msgs = 2048
+    n_msgs = cfg.n_messages or cfg.max_message_count
+    if n_msgs > max_msgs:
+        clamps.append(f"n_messages {n_msgs} -> {max_msgs} "
+                      f"(aligned engine packs <= {max_msgs} messages "
+                      "= 64 int32 planes)")
+        n_msgs = max_msgs
+    n_honest = None
+    if cfg.byzantine_fraction > 0.0:
+        n_junk = max(1, n_msgs // 4)
+        if n_msgs + n_junk > max_msgs:
+            clamps.append(f"n_messages {n_msgs} -> {max_msgs - n_junk} "
+                          f"({max_msgs}-message cap shared with {n_junk} "
+                          "byzantine junk columns)")
+            n_msgs = max_msgs - n_junk
+        n_honest = n_msgs
+        n_msgs = n_msgs + n_junk
+    for c in clamps:
+        print(f"Warning: --engine aligned clamped {c}", file=sys.stderr)
     n_shards = max(1, args.mesh_devices)
     try:
+        # n_msgs shrinks the kernel's VMEM row block for wide message sets
         topo = build_aligned(seed=cfg.prng_seed, n=n, n_slots=n_slots,
                              degree_law=law,
                              powerlaw_alpha=cfg.powerlaw_alpha,
-                             n_shards=n_shards)
+                             n_shards=n_shards, n_msgs=n_msgs)
     except ValueError as e:
         # e.g. the overlay is too small to shard without black-hole
         # padding rows — same clean-exit contract as the engine checks
         print(f"Error: {e}", file=sys.stderr)
         return 1
-    n_msgs = cfg.n_messages or cfg.max_message_count
-    if n_msgs > 32:
-        clamps.append(f"n_messages {n_msgs} -> 32 "
-                      "(aligned engine packs messages into one int32 word)")
-        n_msgs = 32
-    n_honest = None
-    if cfg.byzantine_fraction > 0.0:
-        n_junk = max(1, n_msgs // 4)
-        if n_msgs + n_junk > 32:
-            clamps.append(f"n_messages {n_msgs} -> {32 - n_junk} "
-                          f"(32-word cap shared with {n_junk} byzantine "
-                          "junk columns)")
-            n_msgs = 32 - n_junk
-        n_honest = n_msgs
-        n_msgs = n_msgs + n_junk
-    for c in clamps:
-        print(f"Warning: --engine aligned clamped {c}", file=sys.stderr)
     engine = "aligned"
     try:
-        kw = dict(topo=topo, n_msgs=n_msgs, mode=mode,
+        kw = dict(topo=topo, n_msgs=n_msgs, mode=mode, fanout=cfg.fanout,
                   churn=ChurnConfig(rate=cfg.churn_rate),
                   byzantine_fraction=cfg.byzantine_fraction,
                   n_honest_msgs=n_honest,

@@ -19,15 +19,22 @@ streaming loads, lane-wise `tpu.dynamic_gather` (take_along_axis over the
   ``take_along_axis(..., axis=1)`` that Mosaic lowers to one
   ``tpu.dynamic_gather`` per 8x128 vreg.
 
-Messages are bit-packed: 32 rumors per int32 word, so one [R, 128] int32
-array is the whole network's seen/frontier state and OR is the dedup.
+Messages are bit-packed: 32 rumors per int32 word, W words per peer, so
+one [W, R, 128] int32 array is the whole network's seen/frontier state
+and OR is the dedup.  W is static; the kernel unrolls the plane loop so
+the colidx/gate blocks are read ONCE per (row-block, slot) no matter how
+many message planes ride on them.
 
 The kernel runs a (T row-blocks x D slots) grid, accumulating the slot OR
 into the output block, which stays resident in VMEM across the inner d
 loop (d is the innermost grid dim).  Per-slot gating:
 
 * push pass: slot d live iff ``d < gate`` (gate = per-peer in-degree —
-  the power-law degree law, reference peer.cpp:219-222);
+  the power-law degree law, reference peer.cpp:219-222); with
+  ``fanout=f > 0``, further restricted to a per-round random circular
+  window of f of the peer's live slots (receiver-side rumor mongering —
+  the bounded-fanout variant of the reference's flood, peer.cpp:310-312
+  being the f=deg special case);
 * pull pass: slot d live iff ``d == gate`` (gate = this round's sampled
   contact slot — classic one-neighbor anti-entropy).
 """
@@ -44,8 +51,14 @@ from jax.experimental.pallas import tpu as pltpu
 LANES = 128
 
 
-def _pass_kernel(pull: bool, rolls_ref, subrolls_ref, y_ref, col_ref,
-                 gate_ref, acc_ref):
+def _pass_kernel(pull: bool, n_planes: int, fanout: int, rolls_ref,
+                 subrolls_ref, y_ref, col_ref, gate_ref, *rest):
+    # The shift plane exists only in bounded-fanout mode — flood and pull
+    # runs must not stream a dead int8 block through every grid step.
+    if fanout > 0 and not pull:
+        shift_ref, acc_ref = rest
+    else:
+        (acc_ref,) = rest
     d = pl.program_id(1)
     # Per-slot sublane roll: out-row i reads y-row (i + s_d) % blk, so a
     # peer's D slots see D distinct source rows even when the grid has a
@@ -54,30 +67,42 @@ def _pass_kernel(pull: bool, rolls_ref, subrolls_ref, y_ref, col_ref,
     # pltpu.roll(x, s) moves row i to i+s, i.e. out-row i sees row i-s —
     # so rolling by -s_d would READ row i+s_d; jnp.roll has the same
     # convention but its dynamic-shift form doesn't lower on Mosaic.
-    blk = y_ref.shape[0]
-    y = pltpu.roll(y_ref[:], blk - subrolls_ref[d], axis=0)
+    blk = y_ref.shape[1]
     col = col_ref[0].astype(jnp.int32)
-    z = jnp.take_along_axis(y, col, axis=1)
     g = gate_ref[:].astype(jnp.int32)
-    mask = (g == d) if pull else (d < g)
-    z = jnp.where(mask, z, 0)
+    if pull:
+        mask = g == d
+    elif fanout > 0:
+        # Bounded fanout: slot d live iff it falls in the circular window
+        # [s, s+f) over the peer's g live slots.  Slots are i.i.d. draws,
+        # so a contiguous window is as random a subset as any.
+        s = shift_ref[:].astype(jnp.int32)
+        mask = (d < g) & (jnp.remainder(d - s, jnp.maximum(g, 1)) < fanout)
+    else:
+        mask = d < g
+    # Static unroll over message planes: col/gate stay resident, each
+    # plane costs one sublane roll + one lane-wise dynamic_gather.
+    for w in range(n_planes):
+        y = pltpu.roll(y_ref[w], blk - subrolls_ref[d], axis=0)
+        z = jnp.where(mask, jnp.take_along_axis(y, col, axis=1), 0)
 
-    @pl.when(d == 0)
-    def _():
-        acc_ref[:] = z
+        @pl.when(d == 0)
+        def _(w=w, z=z):
+            acc_ref[w] = z
 
-    @pl.when(d > 0)
-    def _():
-        acc_ref[:] = acc_ref[:] | z
+        @pl.when(d > 0)
+        def _(w=w, z=z):
+            acc_ref[w] = acc_ref[w] | z
 
 
 def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
                 rolls: jax.Array, subrolls: jax.Array, *,
-                pull: bool = False, rowblk: int = 512,
+                pull: bool = False, fanout: int = 0,
+                shift: jax.Array | None = None, rowblk: int = 512,
                 interpret: bool = False) -> jax.Array:
-    """One OR-accumulated D-slot pass.
+    """One OR-accumulated D-slot pass over W message planes.
 
-    ``y``       int32[Ry, 128] — row-permuted packed sender words.  May
+    ``y``       int32[W, Ry, 128] — row-permuted packed sender words.  May
                                  cover MORE rows than the output (the
                                  sharded engine passes the full network's
                                  words while computing only its own row
@@ -88,15 +113,82 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
     ``rolls``   int32[D]       — per-slot block-roll offsets (scalar
                                  prefetch; drives the y index map)
     ``subrolls`` int32[D]      — per-slot sublane roll within the block
-    Returns int32[R, 128]: words each peer hears this pass.
+    ``fanout``/``shift`` — bounded fanout (push only): listen on the
+                fanout-slot circular window starting at ``shift`` (int8
+                [R, 128], per-round random in [0, deg)); fanout=0 floods
+    Returns int32[W, R, 128]: words each peer hears this pass.
     """
-    Ry, C = y.shape
+    W, Ry, C = y.shape
     assert C == LANES, f"lane dim must be {LANES}, got {C}"
     D, R, _ = colidx.shape
     blk = min(rowblk, R)
     assert R % blk == 0 and Ry % blk == 0
     T = R // blk          # output (local) row blocks
     Ty = Ry // blk        # y (possibly global) row blocks
+    fanout = 0 if pull else fanout
+    in_specs = [
+        pl.BlockSpec((W, blk, C),
+                     lambda t, d, k, s: (0, (t + k[d]) % Ty, 0)),
+        pl.BlockSpec((1, blk, C), lambda t, d, k, s: (d, t, 0)),
+        pl.BlockSpec((blk, C), lambda t, d, k, s: (t, 0)),
+    ]
+    operands = [y, colidx, gate]
+    if fanout > 0:
+        assert shift is not None, "bounded fanout needs a shift plane"
+        in_specs.append(pl.BlockSpec((blk, C), lambda t, d, k, s: (t, 0)))
+        operands.append(shift)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(T, D),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((W, blk, C), lambda t, d, k, s: (0, t, 0)),
+    )
+    return pl.pallas_call(
+        functools.partial(_pass_kernel, pull, W, fanout),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((W, R, C), jnp.int32),
+        interpret=interpret,
+    )(rolls, subrolls, *operands)
+
+
+def _count_kernel(rolls_ref, subrolls_ref, y_ref, col_ref, gate_ref,
+                  acc_ref):
+    d = pl.program_id(1)
+    blk = y_ref.shape[0]
+    y = pltpu.roll(y_ref[:], blk - subrolls_ref[d], axis=0)
+    col = col_ref[0].astype(jnp.int32)
+    z = jnp.take_along_axis(y, col, axis=1) & 1   # -1 mask -> 1, 0 -> 0
+    g = gate_ref[:].astype(jnp.int32)
+    z = jnp.where(d < g, z, 0)
+
+    @pl.when(d == 0)
+    def _():
+        acc_ref[:] = z
+
+    @pl.when(d > 0)
+    def _():
+        acc_ref[:] = acc_ref[:] + z
+
+
+def count_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
+               rolls: jax.Array, subrolls: jax.Array, *,
+               rowblk: int = 512, interpret: bool = False) -> jax.Array:
+    """SUM-accumulated D-slot pass: how many of each peer's live in-slots
+    (d < gate) point at a flagged neighbor.
+
+    ``y`` is a single int32[Ry, 128] flag plane (-1 flagged / 0 not) —
+    e.g. transmitting = infected & alive for the SIR model's infection
+    pressure (models/sir.py:sir_round's edge_count_scatter analogue).
+    Returns int32[R, 128] counts in [0, D].
+    """
+    Ry, C = y.shape
+    assert C == LANES, f"lane dim must be {LANES}, got {C}"
+    D, R, _ = colidx.shape
+    blk = min(rowblk, R)
+    assert R % blk == 0 and Ry % blk == 0
+    T = R // blk
+    Ty = Ry // blk
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -109,7 +201,7 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
         out_specs=pl.BlockSpec((blk, C), lambda t, d, k, s: (t, 0)),
     )
     return pl.pallas_call(
-        functools.partial(_pass_kernel, pull),
+        _count_kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((R, C), jnp.int32),
         interpret=interpret,

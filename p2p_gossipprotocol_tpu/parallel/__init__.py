@@ -19,6 +19,7 @@ Modules:
 
 from p2p_gossipprotocol_tpu.parallel.aligned_sharded import (
     AlignedShardedSimulator,
+    AlignedShardedSIRSimulator,
 )
 from p2p_gossipprotocol_tpu.parallel.mesh import make_mesh
 from p2p_gossipprotocol_tpu.parallel.partition import (
@@ -32,6 +33,7 @@ from p2p_gossipprotocol_tpu.parallel.sharded_sim import ShardedSimulator
 __all__ = [
     "make_mesh",
     "AlignedShardedSimulator",
+    "AlignedShardedSIRSimulator",
     "ShardedTopology",
     "partition_topology",
     "shard_state",

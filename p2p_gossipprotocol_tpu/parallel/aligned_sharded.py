@@ -40,6 +40,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator, AlignedState,
                                             AlignedTopology, aligned_round)
+from p2p_gossipprotocol_tpu.aligned_sir import (AlignedSIRSimulator,
+                                                AlignedSIRState,
+                                                aligned_sir_round)
 from p2p_gossipprotocol_tpu.liveness import ChurnConfig
 from p2p_gossipprotocol_tpu.parallel.mesh import PEER_AXIS, make_mesh
 
@@ -60,7 +63,7 @@ def _topo_spec(topo: AlignedTopology) -> AlignedTopology:
 
 def _state_spec(liveness: bool) -> AlignedState:
     return AlignedState(
-        seen_w=P(AXIS, None), frontier_w=P(AXIS, None),
+        seen_w=P(None, AXIS, None), frontier_w=P(None, AXIS, None),
         alive_b=P(AXIS, None), byz_w=P(AXIS, None),
         strikes=P(None, AXIS, None) if liveness else None,
         key=P(), round=P())
@@ -75,6 +78,7 @@ class AlignedShardedSimulator:
     mesh: object = None          # jax.sharding.Mesh; default: all devices
     n_msgs: int = 16
     mode: str = "push"
+    fanout: int = 0
     churn: ChurnConfig = None    # type: ignore[assignment]
     byzantine_fraction: float = 0.0
     n_honest_msgs: int | None = None
@@ -96,6 +100,7 @@ class AlignedShardedSimulator:
         # init_state math and derived masks wholesale.
         self._inner = AlignedSimulator(
             topo=self.topo, n_msgs=self.n_msgs, mode=self.mode,
+            fanout=self.fanout,
             churn=self.churn, byzantine_fraction=self.byzantine_fraction,
             n_honest_msgs=self.n_honest_msgs, max_strikes=self.max_strikes,
             seed=self.seed, interpret=self.interpret)
@@ -133,14 +138,17 @@ class AlignedShardedSimulator:
         global row ids / roll offsets from the shard's position, gather =
         all_gather (globalizes the row-permuted words the kernels read),
         reduce = psum."""
-        rows_l = state.seen_w.shape[0]          # local rows
+        rows_l = state.seen_w.shape[1]          # local rows
         sidx = jax.lax.axis_index(AXIS)
         grow0 = sidx * rows_l
         grows = grow0 + jnp.arange(rows_l, dtype=jnp.int32)
         t_off = (grow0 // topo.rowblk).astype(jnp.int32)
         return aligned_round(
             self._inner, state, topo, grows=grows, t_off=t_off,
-            gather=lambda x: jax.lax.all_gather(x, AXIS, tiled=True),
+            # gather the ROWS axis (ndim-2): axis 0 of the 2D alive
+            # words, axis 1 of the 3D [W, rows, 128] message planes
+            gather=lambda x: jax.lax.all_gather(x, AXIS, axis=x.ndim - 2,
+                                                tiled=True),
             reduce=lambda x: jax.lax.psum(x, AXIS))
 
     # ------------------------------------------------------------------
@@ -243,3 +251,124 @@ class AlignedShardedSimulator:
         rounds_run = int(jax.device_get(st.round))
         wall = _time.perf_counter() - t0
         return st, tp, rounds_run, wall
+
+
+# ----------------------------------------------------------------------
+# SIR on the sharded scale path (BASELINE config 3 beyond one chip).
+
+def _sir_state_spec() -> AlignedSIRState:
+    return AlignedSIRState(
+        inf_b=P(AXIS, None), rec_b=P(AXIS, None), alive_b=P(AXIS, None),
+        key=P(), round=P(), n_peers=0)
+
+
+@dataclass
+class AlignedShardedSIRSimulator:
+    """Drop-in multi-chip counterpart of
+    :class:`aligned_sir.AlignedSIRSimulator` — same constructor surface
+    plus ``mesh``, same SIRResult, bitwise-equal to the unsharded engine
+    (per-global-row fold_in draws, tests/test_aligned_sir.py)."""
+
+    topo: AlignedTopology
+    mesh: object = None
+    beta: float = 0.3
+    gamma: float = 0.1
+    n_seeds: int = 1
+    churn: ChurnConfig = None    # type: ignore[assignment]
+    seed: int = 0
+    interpret: bool | None = None
+
+    def __post_init__(self):
+        if self.mesh is None:
+            self.mesh = make_mesh()
+        self.n_shards = int(np.prod(self.mesh.devices.shape))
+        rows, blk = self.topo.rows, self.topo.rowblk
+        if rows % (self.n_shards * blk):
+            raise ValueError(
+                f"{rows} rows (rowblk {blk}) do not split over "
+                f"{self.n_shards} shards — build the overlay with "
+                f"build_aligned(..., n_shards={self.n_shards})")
+        self._inner = AlignedSIRSimulator(
+            topo=self.topo, beta=self.beta, gamma=self.gamma,
+            n_seeds=self.n_seeds, churn=self.churn, seed=self.seed,
+            interpret=self.interpret)
+        self.churn = self._inner.churn
+        self.interpret = self._inner.interpret
+        self._scan_cache: dict = {}
+
+    # ------------------------------------------------------------------
+    def init_state(self) -> AlignedSIRState:
+        state = self._inner.init_state()
+        spec = _sir_state_spec().replace(n_peers=state.n_peers)
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), spec,
+            is_leaf=lambda x: isinstance(x, P))
+        return jax.device_put(state, shardings)
+
+    def shard_topo(self, topo: AlignedTopology | None = None
+                   ) -> AlignedTopology:
+        topo = self.topo if topo is None else topo
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), _topo_spec(topo),
+            is_leaf=lambda x: isinstance(x, P))
+        return jax.device_put(topo, shardings)
+
+    # ------------------------------------------------------------------
+    def _step_local(self, state: AlignedSIRState, topo: AlignedTopology
+                    ) -> tuple[AlignedSIRState, dict]:
+        rows_l = state.inf_b.shape[0]
+        sidx = jax.lax.axis_index(AXIS)
+        grow0 = sidx * rows_l
+        grows = grow0 + jnp.arange(rows_l, dtype=jnp.int32)
+        t_off = (grow0 // topo.rowblk).astype(jnp.int32)
+        return aligned_sir_round(
+            self._inner, state, topo, grows=grows, t_off=t_off,
+            gather=lambda x: jax.lax.all_gather(x, AXIS, axis=x.ndim - 2,
+                                                tiled=True),
+            reduce=lambda x: jax.lax.psum(x, AXIS))
+
+    # ------------------------------------------------------------------
+    def run(self, rounds: int, state: AlignedSIRState | None = None,
+            warmup: bool = False):
+        """``warmup`` excludes compile + program upload from ``wall_s``
+        (benchmark parity with every other scale-path run())."""
+        import time as _time
+
+        from p2p_gossipprotocol_tpu.sim import SIRResult
+
+        state = self.init_state() if state is None else state
+        topo = self.shard_topo()
+        if rounds not in self._scan_cache:
+            st_spec = _sir_state_spec().replace(n_peers=state.n_peers)
+            tp_spec = _topo_spec(self.topo)
+            metric_spec = {k: P() for k in
+                           ("susceptible", "infected", "recovered",
+                            "new_infections", "live_peers")}
+
+            def scanned(st, tp):
+                def body(carry, _):
+                    s, metrics = self._step_local(carry, tp)
+                    return s, metrics
+                return jax.lax.scan(body, st, None, length=rounds)
+
+            self._scan_cache[rounds] = jax.jit(jax.shard_map(
+                scanned, mesh=self.mesh,
+                in_specs=(st_spec, tp_spec),
+                out_specs=(st_spec, metric_spec),
+                check_vma=False))
+        if warmup:
+            w_state, _ = self._scan_cache[rounds](state, topo)
+            int(jax.device_get(w_state.round))
+        t0 = _time.perf_counter()
+        state, ys = self._scan_cache[rounds](state, topo)
+        int(jax.device_get(state.round))
+        wall = _time.perf_counter() - t0
+        return SIRResult(
+            state=state, topo=self.topo,
+            susceptible=np.asarray(ys["susceptible"]),
+            infected=np.asarray(ys["infected"]),
+            recovered=np.asarray(ys["recovered"]),
+            new_infections=np.asarray(ys["new_infections"]),
+            live_peers=np.asarray(ys["live_peers"]),
+            wall_s=wall,
+        )

@@ -20,27 +20,28 @@ from p2p_gossipprotocol_tpu.sim import Simulator
 
 
 def _numpy_pass(y, colidx, gate, rolls, subrolls, rowblk, pull):
-    """Ground-truth OR-accumulation over slots."""
-    R, C = y.shape
+    """Ground-truth OR-accumulation over slots (y is [W, R, C])."""
+    W, R, C = y.shape
     D = colidx.shape[0]
     blk = min(rowblk, R)
     T = R // blk
-    acc = np.zeros((R, C), np.int32)
+    acc = np.zeros((W, R, C), np.int32)
     r = np.arange(R)
     for d in range(D):
         src_row = (((r // blk + rolls[d]) % T) * blk
                    + (r % blk + subrolls[d]) % blk)
-        z = y[src_row[:, None], colidx[d].astype(np.int64)]
         mask = (gate == d) if pull else (d < gate)
-        acc |= np.where(mask, z, 0)
+        for w in range(W):
+            z = y[w][src_row[:, None], colidx[d].astype(np.int64)]
+            acc[w] |= np.where(mask, z, 0)
     return acc
 
 
 @pytest.fixture(scope="module")
 def small_tables():
     rng = np.random.default_rng(3)
-    R, D = 16, 5
-    y = rng.integers(0, 2**31, size=(R, LANES), dtype=np.int32)
+    R, D, W = 16, 5, 3   # multi-word: 3 message planes
+    y = rng.integers(0, 2**31, size=(W, R, LANES), dtype=np.int32)
     colidx = rng.integers(0, LANES, size=(D, R, LANES), dtype=np.int8)
     deg = rng.integers(0, D + 1, size=(R, LANES), dtype=np.int8)
     rolls = rng.integers(0, 2, size=D, dtype=np.int32)  # T = 2 for blk=8
@@ -61,7 +62,7 @@ def test_push_pass_matches_ground_truth(small_tables):
 def test_pull_pass_matches_ground_truth(small_tables):
     y, colidx, _, rolls, subrolls = small_tables
     rng = np.random.default_rng(7)
-    delta = rng.integers(0, 6, size=y.shape, dtype=np.int8)
+    delta = rng.integers(0, 6, size=y.shape[1:], dtype=np.int8)
     out = gossip_pass(jnp.asarray(y), jnp.asarray(colidx),
                       jnp.asarray(delta), jnp.asarray(rolls),
                       jnp.asarray(subrolls), pull=True,
@@ -85,13 +86,14 @@ def test_neighbor_ids_consistent_with_pass(small_tables):
     assert nbr.min() >= 0 and nbr.max() < 16 * LANES
 
     out = np.asarray(gossip_pass(
-        jnp.asarray(y[perm]), jnp.asarray(colidx), jnp.asarray(deg),
+        jnp.asarray(y[:, perm]), jnp.asarray(colidx), jnp.asarray(deg),
         jnp.asarray(rolls), jnp.asarray(subrolls), pull=False, rowblk=8,
         interpret=True))
-    flat = y.reshape(-1)
     ref = np.zeros_like(out)
-    for d in range(nbr.shape[0]):
-        ref |= np.where(d < deg, flat[nbr[d]], 0)
+    for w in range(y.shape[0]):
+        flat = y[w].reshape(-1)
+        for d in range(nbr.shape[0]):
+            ref[w] |= np.where(d < deg, flat[nbr[d]], 0)
     np.testing.assert_array_equal(out, ref)
 
 
@@ -289,9 +291,9 @@ def test_byzantine_suppression_recovers_honest_coverage():
     res = sim.run(20)
     assert res.coverage[-1] > 0.99
     # junk columns stay confined to byzantine peers
-    junk_mask = int(sim._junk_mask)
+    junk_mask = np.asarray(sim._junk_mask)[:, None, None]
     junk_seen = np.asarray(res.state.seen_w) & junk_mask
-    assert not (junk_seen & ~np.where(byz_b, -1, 0)).any()
+    assert not (junk_seen & ~np.where(byz_b, -1, 0)[None]).any()
 
 
 def test_churn_dynamics_match_exact_engine_statistically():
@@ -359,3 +361,188 @@ def test_pull_mode_converges():
     pp = AlignedSimulator(topo=topo, n_msgs=4, mode="pushpull", seed=3)
     res_pp = pp.run(64)
     assert res_pp.rounds_to(0.99) <= res_pull.rounds_to(0.99)
+
+
+# ----------------------------------------------------------------------
+# Multi-word message planes (> 32 messages — reference peer.cpp:357-366's
+# growing per-peer rumor universe; round-3 verdict item #1)
+
+def _unpack_seen(seen_w, n, n_msgs):
+    """bool[n, n_msgs] view of the bit-packed [W, R, 128] planes."""
+    u = np.asarray(seen_w).view(np.uint32)
+    out = np.zeros((n, n_msgs), bool)
+    for m in range(n_msgs):
+        plane = u[m // 32].reshape(-1)[:n]
+        out[:, m] = (plane >> np.uint32(m % 32)) & np.uint32(1)
+    return out
+
+
+def test_multiword_seed_and_flood():
+    topo = build_aligned(seed=12, n=1024, n_slots=6)
+    sim = AlignedSimulator(topo=topo, n_msgs=80, mode="push", seed=0)
+    assert sim.n_words == 3
+    st = sim.init_state()
+    assert st.seen_w.shape == (3, topo.rows, LANES)
+    seeded = np.asarray(st.seen_w).view(np.uint32)
+    assert np.unpackbits(seeded.view(np.uint8)).sum() == 80
+    res = sim.run(14)
+    assert res.coverage[-1] == pytest.approx(1.0)
+    assert res.frontier_size[-1] == 0
+
+
+def test_multiword_pushpull_deterministic():
+    topo = build_aligned(seed=2, n=1024, n_slots=4)
+    mk = lambda: AlignedSimulator(topo=topo, n_msgs=65, mode="pushpull",  # noqa: E731
+                                  seed=5)
+    ra, rb = mk().run(12), mk().run(12)
+    np.testing.assert_array_equal(np.asarray(ra.state.seen_w),
+                                  np.asarray(rb.state.seen_w))
+    assert ra.coverage[-1] > 0.99
+
+
+def test_multiword_exact_parity_with_edges_engine():
+    """Flood dissemination is deterministic given graph + sources, so the
+    exact edge-list engine consuming the SAME overlay (via the
+    neighbor_ids bridge) with the SAME source placement must produce the
+    IDENTICAL per-message spread at W > 1 — bit-for-bit, not
+    statistically."""
+    from p2p_gossipprotocol_tpu.graph import _pad_and_build
+
+    n, n_msgs, rounds = 512, 48, 8
+    topo = build_aligned(seed=13, n=n, n_slots=4)
+    sim_a = AlignedSimulator(topo=topo, n_msgs=n_msgs, mode="push", seed=0)
+    st_a = sim_a.init_state()
+    seen0 = _unpack_seen(st_a.seen_w, n, n_msgs)
+    assert (seen0.sum(axis=0) == 1).all()      # every message seeded once
+    sources = np.argmax(seen0, axis=0)
+
+    nbr = np.asarray(topo.neighbor_ids())      # [D, R, 128] in-edges
+    deg = np.asarray(topo.deg)
+    peer = np.arange(topo.rows * LANES).reshape(topo.rows, LANES)
+    srcs, dsts = [], []
+    for d in range(nbr.shape[0]):
+        live = d < deg
+        srcs.append(nbr[d][live])
+        dsts.append(peer[live])
+    topo_e = _pad_and_build(n, np.concatenate(srcs), np.concatenate(dsts))
+
+    res_a = sim_a.run(rounds)
+    sim_e = Simulator(topo=topo_e, n_msgs=n_msgs, mode="push", seed=0)
+    st_e = sim_e.init_state(sources=jnp.asarray(sources))
+    res_e = sim_e.run(rounds, state=st_e)
+
+    np.testing.assert_array_equal(
+        _unpack_seen(res_a.state.seen_w, n, n_msgs),
+        np.asarray(res_e.state.seen))
+    np.testing.assert_allclose(res_a.coverage, res_e.coverage, atol=1e-6)
+    np.testing.assert_array_equal(res_a.deliveries, res_e.deliveries)
+
+
+def test_multiword_byzantine_junk_confined():
+    """Junk columns spilling into a SECOND plane (bits 40-49 live in plane
+    1) stay confined to byzantine peers, and honest coverage converges."""
+    topo = build_aligned(seed=14, n=2048, n_slots=8)
+    sim = AlignedSimulator(topo=topo, n_msgs=50, mode="pushpull",
+                           byzantine_fraction=0.1, n_honest_msgs=40,
+                           seed=2)
+    assert sim.n_words == 2
+    st = sim.init_state()
+    byz_b = np.asarray(st.byz_w) != 0
+    seeded = np.asarray(st.seen_w) != 0
+    assert not (seeded & byz_b[None]).any()    # honest sources only
+    res = sim.run(20)
+    assert res.coverage[-1] > 0.99
+    junk_mask = np.asarray(sim._junk_mask)
+    assert junk_mask[0] == 0 and junk_mask[1] != 0   # junk is plane-1 only
+    junk_seen = np.asarray(res.state.seen_w) & junk_mask[:, None, None]
+    assert not (junk_seen & ~np.where(byz_b, -1, 0)[None]).any()
+
+
+def test_vmem_budget_guard():
+    """Wide message sets must shrink the kernel row block; an over-budget
+    (rowblk, W) combination fails at construction with the fix named, not
+    deep inside Mosaic."""
+    topo = build_aligned(seed=1, n=1 << 19, n_slots=2)
+    assert topo.rowblk == 512
+    with pytest.raises(ValueError, match="VMEM"):
+        AlignedSimulator(topo=topo, n_msgs=512, interpret=False)
+    topo2 = build_aligned(seed=1, n=1 << 19, n_slots=2, n_msgs=512)
+    assert topo2.rowblk * 16 <= 4096
+    AlignedSimulator(topo=topo2, n_msgs=512, interpret=False)
+
+
+# ----------------------------------------------------------------------
+# Bounded fanout (rumor mongering) on the aligned engine — round-3
+# verdict item #4; the reference's flood (peer.cpp:310-312) is fanout=deg.
+
+def test_fanout_window_kernel_ground_truth(small_tables):
+    y, colidx, deg, rolls, subrolls = small_tables
+    rng = np.random.default_rng(17)
+    shift = (rng.integers(0, 1 << 30, size=deg.shape)
+             % np.maximum(deg, 1)).astype(np.int8)
+    fanout = 2
+    out = np.asarray(gossip_pass(
+        jnp.asarray(y), jnp.asarray(colidx), jnp.asarray(deg),
+        jnp.asarray(rolls), jnp.asarray(subrolls), pull=False,
+        fanout=fanout, shift=jnp.asarray(shift), rowblk=8, interpret=True))
+    W, R, C = y.shape
+    D = colidx.shape[0]
+    blk, T = 8, R // 8
+    r = np.arange(R)
+    ref = np.zeros_like(out)
+    for d in range(D):
+        src_row = (((r // blk + rolls[d]) % T) * blk
+                   + (r % blk + subrolls[d]) % blk)
+        g = deg.astype(np.int64)
+        mask = (d < g) & (((d - shift) % np.maximum(g, 1)) < fanout)
+        for w in range(W):
+            z = y[w][src_row[:, None], colidx[d].astype(np.int64)]
+            ref[w] |= np.where(mask, z, 0)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_fanout_convergence_matches_edges_engine():
+    """Rumor mongering at the same fanout must show the same
+    rounds-to-99% as the exact engine's sender-side fanout (within the
+    statistical margin the flood comparison uses), and lower fanout must
+    converge no faster than higher.  Mode is pushpull: bounded-fanout
+    pure push is one-shot bond percolation (each edge flips a p=f/deg
+    coin exactly once, while the frontier passes) and plateaus below
+    full coverage in BOTH engines — anti-entropy is what makes rumor
+    mongering converge, and is what the BASELINE configs run."""
+    n, d = 4096, 12
+    rounds = {}
+    for fanout in (2, 6):
+        topo_a = build_aligned(seed=23, n=n, n_slots=d)
+        sim_a = AlignedSimulator(topo=topo_a, n_msgs=8, mode="pushpull",
+                                 fanout=fanout, seed=0)
+        res_a = sim_a.run(48)
+        assert res_a.coverage[-1] > 0.99, fanout
+        rounds[fanout] = int(np.argmax(res_a.coverage >= 0.99)) + 1
+
+        topo_e = graph.erdos_renyi(23, n, avg_degree=d)
+        sim_e = Simulator(topo=topo_e, n_msgs=8, mode="pushpull",
+                          fanout=fanout, seed=0)
+        res_e = sim_e.run(48)
+        r_exact = res_e.rounds_to(0.99)
+        assert r_exact > 0
+        assert abs(rounds[fanout] - r_exact) <= 3, (fanout, rounds[fanout],
+                                                    r_exact)
+    assert rounds[2] >= rounds[6]
+
+    # the percolation plateau itself must also agree across engines
+    pa = AlignedSimulator(topo=build_aligned(seed=23, n=n, n_slots=d),
+                          n_msgs=8, mode="push", fanout=2, seed=0).run(48)
+    pe = Simulator(topo=graph.erdos_renyi(23, n, avg_degree=d), n_msgs=8,
+                   mode="push", fanout=2, seed=0).run(48)
+    assert abs(float(pa.coverage[-1]) - float(pe.coverage[-1])) < 0.1
+
+
+def test_fanout_deterministic():
+    topo = build_aligned(seed=24, n=1024, n_slots=8)
+    mk = lambda: AlignedSimulator(topo=topo, n_msgs=40, mode="pushpull",  # noqa: E731
+                                  fanout=3, seed=9)
+    ra, rb = mk().run(16), mk().run(16)
+    np.testing.assert_array_equal(np.asarray(ra.state.seen_w),
+                                  np.asarray(rb.state.seen_w))
+    assert ra.coverage[-1] > 0.99

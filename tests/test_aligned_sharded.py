@@ -113,3 +113,30 @@ def test_sharded_pull_mode_matches_unsharded(devices8, topo8):
     np.testing.assert_array_equal(np.asarray(r8.state.seen_w),
                                   np.asarray(ru.state.seen_w))
     assert float(r8.coverage[-1]) > 0.99
+
+
+def test_sharded_multiword_bitwise(devices8, topo8):
+    """W > 1 message planes under the sharded engine: same exact-equality
+    contract (byzantine junk spills into plane 2, full feature set on)."""
+    kw = dict(KW, n_msgs=72, n_honest_msgs=64)
+    ru = AlignedSimulator(topo=topo8, **kw).run(10)
+    rs = AlignedShardedSimulator(topo=topo8, mesh=make_mesh(8), **kw).run(10)
+    assert np.asarray(ru.state.seen_w).shape[0] == 3
+    np.testing.assert_array_equal(np.asarray(ru.state.seen_w),
+                                  np.asarray(rs.state.seen_w))
+    np.testing.assert_array_equal(np.asarray(ru.topo.colidx),
+                                  np.asarray(rs.topo.colidx))
+    np.testing.assert_array_equal(ru.coverage, rs.coverage)
+    np.testing.assert_array_equal(ru.evictions, rs.evictions)
+
+
+def test_sharded_fanout_bitwise(devices8, topo8):
+    """Bounded fanout under the sharded engine: exact equality again."""
+    kw = dict(KW, mode="pushpull")
+    kw["n_msgs"] = 8
+    ru = AlignedSimulator(topo=topo8, fanout=2, **kw).run(12)
+    rs = AlignedShardedSimulator(topo=topo8, mesh=make_mesh(8), fanout=2,
+                                 **kw).run(12)
+    np.testing.assert_array_equal(np.asarray(ru.state.seen_w),
+                                  np.asarray(rs.state.seen_w))
+    np.testing.assert_array_equal(ru.coverage, rs.coverage)
