@@ -81,7 +81,6 @@ def main() -> int:
             cwd=workdir, env=env,
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
 
-    ok = True
     try:
         print(f"[demo] workdir: {workdir}")
         print(f"[demo] starting seed on :{seed_port}")
@@ -181,8 +180,6 @@ def main() -> int:
         for p in procs.values():
             if p.poll() is None:
                 p.kill()
-        if not ok:
-            pass
 
 
 if __name__ == "__main__":
