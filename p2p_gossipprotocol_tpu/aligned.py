@@ -50,6 +50,10 @@ WORD_BITS = 32
 # ~16 MiB core VMEM).  build_aligned picks rowblk accordingly.
 MAX_WORDS_X_ROWBLK = 4096
 
+# Message ceiling for the config-driven entry points (from_config / CLI):
+# 64 int32 planes, far past every BASELINE config.
+MAX_CONFIG_MSGS = 2048
+
 
 def n_msg_words(n_msgs: int) -> int:
     """Message planes needed for ``n_msgs`` bit-packed rumors."""
@@ -62,6 +66,41 @@ def mask_words(n_bits: int, n_planes: int) -> jax.Array:
     k = np.clip(n_bits - WORD_BITS * np.arange(n_planes), 0, WORD_BITS)
     vals = ((np.uint64(1) << k.astype(np.uint64)) - 1).astype(np.uint32)
     return jnp.asarray(vals.view(np.int32))
+
+
+def resolve_overlay(cfg, n_peers: int | None = None,
+                    clamps: list[str] | None = None
+                    ) -> tuple[int, str, int]:
+    """(n_peers, degree_law, n_slots) for the aligned overlay family from
+    a parsed NetworkConfig — shared by the gossip and SIR config entry
+    points (CLI and facade).  Engine ceilings (int8 slot index →
+    n_slots ≤ 127) and model substitutions are appended to ``clamps`` —
+    never silently weaken the configured scenario (the
+    parsed-then-quietly-altered defect class, SURVEY §2-C2).  Raises
+    ValueError for an overlay the family cannot express."""
+    clamps = clamps if clamps is not None else []
+    n = n_peers or cfg.n_peers or len(cfg.seed_nodes)
+    if cfg.graph in ("reference", "powerlaw"):
+        law = "powerlaw"
+    elif cfg.graph == "er":
+        law = "regular"        # ER == uniform slot count, the direct analogue
+    elif cfg.graph == "ba":
+        # Preferential attachment has no aligned analogue; the heavy
+        # tail is what matters for dissemination/epidemic dynamics, so
+        # substitute the power-law degree family — surfaced, not silent.
+        law = "powerlaw"
+        clamps.append("graph ba -> aligned power-law degree family "
+                      "(preferential attachment has no aligned analogue)")
+    else:
+        raise ValueError(
+            f"the aligned engine supports reference/powerlaw/er/ba "
+            f"overlays, not {cfg.graph!r} (use the edges engine)")
+    n_slots = cfg.avg_degree or 16
+    if n_slots > 127:
+        clamps.append(f"avg_degree {n_slots} -> 127 "
+                      "(aligned engine slot index is int8)")
+        n_slots = 127
+    return n, law, n_slots
 
 
 @struct.dataclass
@@ -330,6 +369,58 @@ class AlignedSimulator:
                            & ~self._honest_mask)
         self._run_cache: dict = {}
         self._loop_cache: dict = {}
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_config(cls, cfg, n_peers: int | None = None,
+                    n_shards: int = 1,
+                    clamps: list[str] | None = None) -> "AlignedSimulator":
+        """Build the scale engine from a parsed NetworkConfig — the
+        facade/CLI entry, mirroring sim.Simulator.from_config.  Engine
+        ceilings and model substitutions land in ``clamps`` (the CLI
+        prints them and records them in the result line) — never a
+        silent weakening of the configured scenario.  Raises ValueError
+        for a scenario the engine cannot express (``mode=sir`` lives in
+        aligned_sir.AlignedSIRSimulator).  ``n_shards > 1`` lays the
+        overlay out for the sharded engine; lift the fields onto
+        parallel.AlignedShardedSimulator the way the CLI does."""
+        clamps = clamps if clamps is not None else []
+        if cfg.mode not in ("push", "pull", "pushpull"):
+            raise ValueError(
+                f"the aligned engine supports push/pull/pushpull, not "
+                f"{cfg.mode!r} (sir: aligned_sir.AlignedSIRSimulator)")
+        n, law, n_slots = resolve_overlay(cfg, n_peers=n_peers,
+                                          clamps=clamps)
+        n_msgs = cfg.n_messages or cfg.max_message_count
+        if n_msgs > MAX_CONFIG_MSGS:
+            clamps.append(
+                f"n_messages {n_msgs} -> {MAX_CONFIG_MSGS} "
+                f"(aligned engine packs <= {MAX_CONFIG_MSGS} messages "
+                "= 64 int32 planes)")
+            n_msgs = MAX_CONFIG_MSGS
+        n_honest = None
+        if cfg.byzantine_fraction > 0.0:
+            n_junk = max(1, n_msgs // 4)
+            if n_msgs + n_junk > MAX_CONFIG_MSGS:
+                clamps.append(
+                    f"n_messages {n_msgs} -> {MAX_CONFIG_MSGS - n_junk} "
+                    f"({MAX_CONFIG_MSGS}-message cap shared with "
+                    f"{n_junk} byzantine junk columns)")
+                n_msgs = MAX_CONFIG_MSGS - n_junk
+            n_honest = n_msgs
+            n_msgs = n_msgs + n_junk
+        # n_msgs shrinks the kernel's VMEM row block for wide message sets
+        topo = build_aligned(seed=cfg.prng_seed, n=n, n_slots=n_slots,
+                             degree_law=law,
+                             powerlaw_alpha=cfg.powerlaw_alpha,
+                             n_shards=n_shards, n_msgs=n_msgs)
+        return cls(topo=topo, n_msgs=n_msgs, mode=cfg.mode,
+                   fanout=cfg.fanout,
+                   churn=ChurnConfig(rate=cfg.churn_rate),
+                   byzantine_fraction=cfg.byzantine_fraction,
+                   n_honest_msgs=n_honest,
+                   max_strikes=cfg.max_missed_pings,
+                   seed=cfg.prng_seed)
 
     # ------------------------------------------------------------------
     def init_state(self) -> AlignedState:

@@ -52,9 +52,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="jax mode: overlay model override (same as the "
                         "graph= config key)")
     p.add_argument("--engine", choices=["edges", "aligned"],
-                   default="edges",
+                   default=None,
                    help="jax mode: exact edge-list engine, or the "
-                        "hardware-aligned pallas engine (1M+ peers)")
+                        "hardware-aligned pallas engine (1M+ peers); "
+                        "default: the config's engine= key (edges)")
     p.add_argument("--mesh-devices", type=int, default=0, metavar="N",
                    help="jax mode: shard the peer axis over an N-device "
                         "mesh (ShardedSimulator / "
@@ -158,13 +159,14 @@ def _run_jax_sir_aligned(cfg: NetworkConfig, args, rounds,
                          metrics_lib) -> int:
     """BASELINE config 3 on the scale path: the aligned overlay's SIR
     engine (aligned_sir.py), single-chip or sharded over --mesh-devices."""
-    from p2p_gossipprotocol_tpu.aligned import build_aligned
+    from p2p_gossipprotocol_tpu.aligned import build_aligned, resolve_overlay
     from p2p_gossipprotocol_tpu.aligned_sir import AlignedSIRSimulator
     from p2p_gossipprotocol_tpu.liveness import ChurnConfig
 
     clamps: list[str] = []
     try:
-        n, law, n_slots = _resolve_aligned_overlay(cfg, args, clamps)
+        n, law, n_slots = resolve_overlay(cfg, n_peers=args.n_peers,
+                                          clamps=clamps)
     except ValueError as e:
         print(f"Error: {e}", file=sys.stderr)
         return 1
@@ -244,113 +246,47 @@ def _report_sir(res, *, n_peers, engine, args, metrics_lib,
     print(json.dumps(out))
 
 
-def _resolve_aligned_overlay(cfg: NetworkConfig, args,
-                             clamps: list[str]) -> tuple[int, str, int]:
-    """(n_peers, degree_law, n_slots) for the aligned overlay family,
-    shared by the gossip and SIR aligned paths.  Engine ceilings
-    (aligned.py: int8 slot index → n_slots ≤ 127) and model substitutions
-    are appended to ``clamps`` — never silently weaken the configured
-    scenario (the parsed-then-quietly-altered defect class, SURVEY
-    §2-C2): every entry is printed on stderr and lands in the result
-    line.  Raises ValueError for an overlay the family cannot express."""
-    n = args.n_peers or cfg.n_peers or len(cfg.seed_nodes)
-    if cfg.graph in ("reference", "powerlaw"):
-        law = "powerlaw"
-    elif cfg.graph == "er":
-        law = "regular"        # ER == uniform slot count, the direct analogue
-    elif cfg.graph == "ba":
-        # Preferential attachment has no aligned analogue; the heavy
-        # tail is what matters for dissemination/epidemic dynamics, so
-        # substitute the power-law degree family — surfaced, not silent.
-        law = "powerlaw"
-        clamps.append("graph ba -> aligned power-law degree family "
-                      "(preferential attachment has no aligned analogue)")
-    else:
-        raise ValueError(
-            f"--engine aligned supports reference/powerlaw/er/ba "
-            f"overlays, not {cfg.graph!r} (use --engine edges)")
-    n_slots = cfg.avg_degree or 16
-    if n_slots > 127:
-        clamps.append(f"avg_degree {n_slots} -> 127 "
-                      "(aligned engine slot index is int8)")
-        n_slots = 127
-    return n, law, n_slots
-
-
 def _run_jax_aligned(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
-    from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator,
-                                                build_aligned)
-    from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+    from p2p_gossipprotocol_tpu.aligned import AlignedSimulator
 
-    if cfg.mode not in ("push", "pull", "pushpull"):
-        print(f"Error: --engine aligned supports push/pull/pushpull/sir, "
-              f"not {cfg.mode!r}", file=sys.stderr)
-        return 1
-    mode = cfg.mode
     clamps: list[str] = []
-    try:
-        n, law, n_slots = _resolve_aligned_overlay(cfg, args, clamps)
-    except ValueError as e:
-        print(f"Error: {e}", file=sys.stderr)
-        return 1
-    # The CLI bounds the bit-packed message planes at 64 words = 2048
-    # messages, far past every BASELINE config.
-    max_msgs = 2048
-    n_msgs = cfg.n_messages or cfg.max_message_count
-    if n_msgs > max_msgs:
-        clamps.append(f"n_messages {n_msgs} -> {max_msgs} "
-                      f"(aligned engine packs <= {max_msgs} messages "
-                      "= 64 int32 planes)")
-        n_msgs = max_msgs
-    n_honest = None
-    if cfg.byzantine_fraction > 0.0:
-        n_junk = max(1, n_msgs // 4)
-        if n_msgs + n_junk > max_msgs:
-            clamps.append(f"n_messages {n_msgs} -> {max_msgs - n_junk} "
-                          f"({max_msgs}-message cap shared with {n_junk} "
-                          "byzantine junk columns)")
-            n_msgs = max_msgs - n_junk
-        n_honest = n_msgs
-        n_msgs = n_msgs + n_junk
-    for c in clamps:
-        print(f"Warning: --engine aligned clamped {c}", file=sys.stderr)
     n_shards = max(1, args.mesh_devices)
     try:
-        # n_msgs shrinks the kernel's VMEM row block for wide message sets
-        topo = build_aligned(seed=cfg.prng_seed, n=n, n_slots=n_slots,
-                             degree_law=law,
-                             powerlaw_alpha=cfg.powerlaw_alpha,
-                             n_shards=n_shards, n_msgs=n_msgs)
+        # from_config owns every engine ceiling (overlay family, 2048-
+        # message cap, byzantine junk budget, int8 strike range, VMEM
+        # row-block budget) — shared with the wrapper facade.
+        sim = AlignedSimulator.from_config(cfg, n_peers=args.n_peers,
+                                           n_shards=n_shards,
+                                           clamps=clamps)
     except ValueError as e:
-        # e.g. the overlay is too small to shard without black-hole
-        # padding rows — same clean-exit contract as the engine checks
+        # fail cleanly like the mode/fanout checks instead of leaking a
+        # traceback (values --engine edges accepts, e.g. max_missed_pings
+        # outside the int8 strike range)
         print(f"Error: {e}", file=sys.stderr)
         return 1
+    for c in clamps:
+        print(f"Warning: --engine aligned clamped {c}", file=sys.stderr)
     engine = "aligned"
-    try:
-        kw = dict(topo=topo, n_msgs=n_msgs, mode=mode, fanout=cfg.fanout,
-                  churn=ChurnConfig(rate=cfg.churn_rate),
-                  byzantine_fraction=cfg.byzantine_fraction,
-                  n_honest_msgs=n_honest,
-                  max_strikes=cfg.max_missed_pings,
-                  seed=cfg.prng_seed)
-        if n_shards > 1:
-            from p2p_gossipprotocol_tpu.parallel import (
-                AlignedShardedSimulator, make_mesh)
+    if n_shards > 1:
+        from p2p_gossipprotocol_tpu.parallel import (
+            AlignedShardedSimulator, make_mesh)
 
-            sim = AlignedShardedSimulator(mesh=make_mesh(n_shards), **kw)
-            engine = f"aligned-sharded-{n_shards}"
-        else:
-            sim = AlignedSimulator(**kw)
-    except ValueError as e:
-        # e.g. max_missed_pings outside the engine's int8 strike range —
-        # values --engine edges accepts; fail cleanly like the mode/fanout
-        # checks above instead of leaking a traceback.
-        print(f"Error: {e}", file=sys.stderr)
-        return 1
+        try:
+            sim = AlignedShardedSimulator(
+                mesh=make_mesh(n_shards), topo=sim.topo,
+                n_msgs=sim.n_msgs, mode=sim.mode, fanout=sim.fanout,
+                churn=sim.churn,
+                byzantine_fraction=sim.byzantine_fraction,
+                n_honest_msgs=sim.n_honest_msgs,
+                max_strikes=sim.max_strikes, seed=sim.seed)
+        except ValueError as e:
+            print(f"Error: {e}", file=sys.stderr)
+            return 1
+        engine = f"aligned-sharded-{n_shards}"
+    n = sim.topo.n_peers
     if not args.quiet:
-        print(f"[jax/aligned] simulating {n} peers, {n_msgs} messages, "
-              f"mode={mode}, {sim.topo.n_slots} slots/peer, "
+        print(f"[jax/aligned] simulating {n} peers, {sim.n_msgs} "
+              f"messages, mode={sim.mode}, {sim.topo.n_slots} slots/peer, "
               f"churn={cfg.churn_rate:g}, "
               f"byzantine={cfg.byzantine_fraction:g}, engine={engine}")
     res = sim.run(rounds)
@@ -448,6 +384,9 @@ def main(argv: list[str] | None = None) -> int:
         cfg.graph = args.graph
     if args.wire_format:
         cfg.wire_format = args.wire_format
+    if args.engine:
+        cfg.engine = args.engine
+    args.engine = cfg.engine
 
     if not args.quiet:
         print(cfg.to_string())  # main.cpp:48

@@ -122,6 +122,11 @@ _SIM_STR_KEYS = {
     "graph_backend": "graph_backend",
     "mode": "mode",
     "wire_format": "wire_format",
+    # jax backend: exact edge-list engine, or the hardware-aligned
+    # pallas scale engine (1M+ peers) — reachable from the facade and
+    # the CLI alike, so a reference-parity deployment can opt into the
+    # scale path without leaving the config file.
+    "engine": "engine",
 }
 
 
@@ -143,6 +148,7 @@ class NetworkConfig:
         self.graph_backend = "numpy"   # numpy | native (C++ builders)
         self.wire_format = "json"      # json (reference-compat) | framed
         self.mode = "push"
+        self.engine = "edges"          # edges | aligned (jax backend)
         self.n_peers = 0
         self.n_messages = 0
         self.avg_degree = 8
@@ -286,6 +292,8 @@ class NetworkConfig:
             raise ConfigError(f"Unknown wire_format: {self.wire_format}")
         if self.mode not in ("push", "pull", "pushpull", "sir"):
             raise ConfigError(f"Unknown gossip mode: {self.mode}")
+        if self.engine not in ("edges", "aligned"):
+            raise ConfigError(f"Unknown engine: {self.engine}")
         for k in ("sir_beta", "sir_gamma"):
             if not (0.0 <= getattr(self, k) <= 1.0):
                 raise ConfigError(f"{k} must be in [0, 1]")

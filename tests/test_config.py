@@ -195,3 +195,21 @@ def test_local_address_validated(tmp_path):
 def test_negative_sim_ints_rejected(tmp_path):
     with pytest.raises(ConfigError, match="must be non-negative"):
         NetworkConfig(write(tmp_path, "n_peers=-5\n10.0.0.1:9000\n"))
+
+
+def test_engine_key(tmp_path):
+    cfg = tmp_path / "net.txt"
+    cfg.write_text("10.0.0.1:8000\nengine=aligned\n")
+    from p2p_gossipprotocol_tpu.config import NetworkConfig
+    assert NetworkConfig(str(cfg)).engine == "aligned"
+
+
+def test_engine_key_default_and_invalid(tmp_path):
+    import pytest
+    from p2p_gossipprotocol_tpu.config import ConfigError, NetworkConfig
+    cfg = tmp_path / "net.txt"
+    cfg.write_text("10.0.0.1:8000\n")
+    assert NetworkConfig(str(cfg)).engine == "edges"
+    cfg.write_text("10.0.0.1:8000\nengine=warp\n")
+    with pytest.raises(ConfigError, match="Unknown engine"):
+        NetworkConfig(str(cfg))
