@@ -68,12 +68,36 @@ def build_parser() -> argparse.ArgumentParser:
                    help="socket mode: reference-compatible unframed JSON "
                         "or length-framed (same as the wire_format= "
                         "config key)")
+    p.add_argument("--checkpoint-every", type=int, default=0, metavar="N",
+                   help="jax mode: checkpoint the full simulation state "
+                        "every N rounds (orbax) into --checkpoint-dir")
+    p.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                   help="where checkpoints live (required with "
+                        "--checkpoint-every / --resume)")
+    p.add_argument("--resume", action="store_true",
+                   help="jax mode: continue from the checkpoint in "
+                        "--checkpoint-dir; the completed run's summary "
+                        "is identical to an uninterrupted one")
     p.add_argument("--metrics-jsonl", default=None, metavar="PATH",
                    help="write per-round metrics as JSONL")
     p.add_argument("--profile-dir", default=None, metavar="DIR",
                    help="jax.profiler trace directory for the run")
     p.add_argument("--quiet", action="store_true")
     return p
+
+
+def _run_sim(sim, rounds, args):
+    """sim.run(rounds), optionally through the checkpoint runner (the
+    CLI face of utils.checkpoint.run_with_checkpoints: kill a run, pass
+    --resume, get the summary an uninterrupted run would print)."""
+    if args.checkpoint_every > 0 or args.resume:
+        from p2p_gossipprotocol_tpu.utils.checkpoint import \
+            run_with_checkpoints
+
+        return run_with_checkpoints(
+            sim, rounds, every=args.checkpoint_every or rounds,
+            directory=args.checkpoint_dir, resume=args.resume)
+    return sim.run(rounds)
 
 
 def _run_jax(cfg: NetworkConfig, args) -> int:
@@ -131,7 +155,7 @@ def _run_jax(cfg: NetworkConfig, args) -> int:
             print(f"[jax] simulating {sim.topo.n_peers} peers, "
                   f"{sim.n_msgs} messages, mode={sim.mode}, "
                   f"{int(sim.topo.n_edges())} edges, engine={engine}")
-        res = sim.run(rounds)
+        res = _run_sim(sim, rounds, args)
     _report(res, sim, n_peers=sim.topo.n_peers, engine=engine,
             args=args, metrics_lib=metrics_lib)
     return 0
@@ -149,7 +173,7 @@ def _run_jax_sir(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
         print(f"[jax/sir] simulating {sim.topo.n_peers} peers, "
               f"beta={sim.beta:g}, gamma={sim.gamma:g}, "
               f"{int(sim.topo.n_edges())} edges")
-    res = sim.run(rounds)
+    res = _run_sim(sim, rounds, args)
     _report_sir(res, n_peers=sim.topo.n_peers, engine="edges", args=args,
                 metrics_lib=metrics_lib)
     return 0
@@ -197,7 +221,7 @@ def _run_jax_sir_aligned(cfg: NetworkConfig, args, rounds,
         print(f"[jax/sir] simulating {n} peers, beta={cfg.sir_beta:g}, "
               f"gamma={cfg.sir_gamma:g}, {topo.n_slots} slots/peer, "
               f"engine={engine}")
-    res = sim.run(rounds)
+    res = _run_sim(sim, rounds, args)
     _report_sir(res, n_peers=n, engine=engine, args=args,
                 metrics_lib=metrics_lib, clamps=clamps)
     return 0
@@ -289,7 +313,7 @@ def _run_jax_aligned(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
               f"messages, mode={sim.mode}, {sim.topo.n_slots} slots/peer, "
               f"churn={cfg.churn_rate:g}, "
               f"byzantine={cfg.byzantine_fraction:g}, engine={engine}")
-    res = sim.run(rounds)
+    res = _run_sim(sim, rounds, args)
     _report(res, sim, n_peers=n, engine=engine,
             args=args, metrics_lib=metrics_lib, clamps=clamps)
     return 0
@@ -387,6 +411,17 @@ def main(argv: list[str] | None = None) -> int:
     if args.engine:
         cfg.engine = args.engine
     args.engine = cfg.engine
+
+    if (args.checkpoint_every > 0 or args.resume) \
+            and not args.checkpoint_dir:
+        print("Error: --checkpoint-every/--resume need --checkpoint-dir",
+              file=sys.stderr)
+        return 1
+    if args.checkpoint_dir and cfg.backend != "jax":
+        print("Error: checkpointing is a jax-backend feature (the socket "
+              "runtime is the reference's in-memory-only model)",
+              file=sys.stderr)
+        return 1
 
     if not args.quiet:
         print(cfg.to_string())  # main.cpp:48

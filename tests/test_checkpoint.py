@@ -91,3 +91,60 @@ def test_sharded_aligned_resume_bitwise(tmp_path, devices8):
     np.testing.assert_array_equal(np.asarray(resumed.topo.colidx),
                                   np.asarray(full.topo.colidx))
     assert int(resumed.state.round) == int(full.state.round) == 8
+
+
+def test_run_with_checkpoints_resume_matches_uninterrupted(tmp_path):
+    """The checkpoint RUNNER (utils.checkpoint.run_with_checkpoints — the
+    engine under the CLI's --checkpoint-every/--resume): stop after 4 of
+    8 rounds, resume from disk, and the completed result must carry the
+    bitwise state AND the full 8-round metric history an uninterrupted
+    run produces."""
+    topo = build_aligned(seed=2, n=1024, n_slots=6)
+
+    def mk():
+        return AlignedSimulator(topo=topo, n_msgs=8, mode="pushpull",
+                                churn=ChurnConfig(rate=0.05, kill_round=1),
+                                seed=3)
+
+    full = mk().run(8)
+    d = str(tmp_path / "ck")
+    partial = checkpoint.run_with_checkpoints(mk(), 4, every=2, directory=d)
+    np.testing.assert_array_equal(partial.coverage, full.coverage[:4])
+
+    # a FRESH process resumes from disk (new sim object, same config)
+    resumed = checkpoint.run_with_checkpoints(mk(), 8, every=2,
+                                              directory=d, resume=True)
+    np.testing.assert_array_equal(resumed.coverage, full.coverage)
+    np.testing.assert_array_equal(resumed.evictions, full.evictions)
+    np.testing.assert_array_equal(np.asarray(resumed.state.seen_w),
+                                  np.asarray(full.state.seen_w))
+    np.testing.assert_array_equal(np.asarray(resumed.topo.colidx),
+                                  np.asarray(full.topo.colidx))
+    assert int(resumed.state.round) == int(full.state.round) == 8
+
+
+def test_run_with_checkpoints_sharded(tmp_path, devices8):
+    """Same contract across the 8-device mesh: the runner checkpoints
+    sharded device arrays (AlignedShardedSimulator state + rewired
+    topology) and a fresh simulator resumes them bitwise."""
+    from p2p_gossipprotocol_tpu.parallel import (AlignedShardedSimulator,
+                                                 make_mesh)
+
+    topo = build_aligned(seed=5, n=2048, n_slots=6, rowblk=1, n_shards=8)
+
+    def mk():
+        return AlignedShardedSimulator(
+            topo=topo, mesh=make_mesh(8), n_msgs=8, mode="pushpull",
+            churn=ChurnConfig(rate=0.05, kill_round=1), max_strikes=2,
+            seed=3)
+
+    full = mk().run(8)
+    d = str(tmp_path / "ck")
+    checkpoint.run_with_checkpoints(mk(), 4, every=4, directory=d)
+    resumed = checkpoint.run_with_checkpoints(mk(), 8, every=4,
+                                              directory=d, resume=True)
+    np.testing.assert_array_equal(resumed.coverage, full.coverage)
+    np.testing.assert_array_equal(np.asarray(resumed.state.seen_w),
+                                  np.asarray(full.state.seen_w))
+    np.testing.assert_array_equal(np.asarray(resumed.topo.colidx),
+                                  np.asarray(full.topo.colidx))
