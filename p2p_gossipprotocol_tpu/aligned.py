@@ -304,12 +304,21 @@ class AlignedSimulator:
     byzantine_fraction: float = 0.0
     n_honest_msgs: int | None = None   # None → all columns honest
     max_strikes: int = 3
+    #: run the liveness/rewire pass every k-th round (1 = every round).
+    #: The reference probes on a SLOWER cadence than it gossips (13 s
+    #: ping sweeps vs 5 s messages, peer.cpp:330/377 — one sweep per
+    #: ~2.6 message intervals), so a stride of 2-3 is the faithful
+    #: setting, and it removes the pass's HBM traffic (colidx + strikes
+    #: + alive gather, ~half the round's bytes) from off-rounds.
+    liveness_every: int = 1
     seed: int = 0
     interpret: bool | None = None   # None -> interpret unless on TPU
 
     def __post_init__(self):
         if self.n_msgs <= 0:
             raise ValueError("n_msgs must be positive")
+        if self.liveness_every < 1:
+            raise ValueError("liveness_every must be >= 1")
         self.n_words = n_msg_words(self.n_msgs)
         if self.mode not in ("push", "pull", "pushpull"):
             raise ValueError(f"Unknown gossip mode: {self.mode}")
@@ -420,7 +429,54 @@ class AlignedSimulator:
                    byzantine_fraction=cfg.byzantine_fraction,
                    n_honest_msgs=n_honest,
                    max_strikes=cfg.max_missed_pings,
+                   # probe cadence from the config's own intervals: one
+                   # liveness sweep per ping_interval of message rounds
+                   # (reference defaults 13 s / 5 s → every 3rd round)
+                   liveness_every=max(1, round(
+                       cfg.get_ping_interval()
+                       / max(cfg.get_message_interval(), 1))),
                    seed=cfg.prng_seed)
+
+    # ------------------------------------------------------------------
+    def hbm_bytes_per_round(self) -> int:
+        """Analytic HBM traffic model for one average round — the
+        denominator behind the bench line's ``achieved_gb_s`` (measured
+        wall-clock per round vs bytes this model says the round moves,
+        comparable against the chip's ~800 GB/s HBM roof).
+
+        Counts, per pallas pass, each block the grid streams exactly once
+        (a block whose index map is constant across the inner grid dim
+        stays resident in VMEM and is counted once): the gossip pass
+        streams the packed sender planes D times (one roll per slot),
+        the lane tables once; the liveness pass (amortized over
+        ``liveness_every``) streams the alive plane D times plus
+        colidx/strikes in and out; plus the XLA-side prep (permute
+        gather, frontier masking, popcount metrics) at one read+write
+        per touched plane."""
+        R = self.topo.rows
+        D = self.topo.n_slots
+        W = self.n_words
+        plane = R * LANES * 4            # one int32[R, 128] plane
+        word_planes = W * plane          # int32[W, R, 128]
+        slot8 = D * R * LANES            # one int8[D, R, 128] table
+
+        gossip_pass_bytes = (D * word_planes      # y streamed per slot
+                             + slot8              # colidx
+                             + R * LANES          # gate
+                             + word_planes)       # OR-accumulator out
+        prep = 3 * word_planes                    # mask + permute gather
+        n_passes = 2 if self.mode == "pushpull" else 1
+        total = n_passes * (gossip_pass_bytes + prep)
+        if self.fanout > 0:
+            total += R * LANES                    # shift plane
+        if self._liveness:
+            liveness = (D * plane                 # alive plane per slot
+                        + 4 * slot8               # colidx/strikes r+w
+                        + 2 * slot8               # evict8 write + reduce
+                        + 3 * plane)              # gather/prep
+            total += liveness // self.liveness_every
+        total += 4 * word_planes                  # seen|new update + metrics
+        return int(total)
 
     # ------------------------------------------------------------------
     def init_state(self) -> AlignedState:
@@ -599,7 +655,11 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
         return jnp.take(x, topo.perm, axis=x.ndim - 2)
 
     valid_b = topo.valid_w != 0
+    # k_rew is retired (rewire candidates are hashed in-kernel) but the
+    # 5-way split is kept so the round's key schedule — and with it every
+    # churn/pull/fanout trajectory — is unchanged.
     key, k_churn, k_rew, k_pull, k_fan = jax.random.split(state.key, 5)
+    del k_rew
 
     alive_b = state.alive_b
     if sim.churn.rate > 0.0 or sim.churn.revive > 0.0:
@@ -611,15 +671,36 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
     n_evict = jnp.int32(0)
     rolls_off = topo.rolls + t_off
     if sim._liveness:
-        y_alive = prow(gather(alive_w))
-        rand = row_randint(k_rew, grows, (topo.n_slots, LANES),
-                           0, LANES, jnp.int8).transpose(1, 0, 2)
-        colidx, strikes, evict8 = liveness_pass(
-            y_alive, topo.colidx, strikes, rand, topo.deg,
-            rolls_off, topo.subrolls, max_strikes=sim.max_strikes,
-            rowblk=topo.rowblk, interpret=sim.interpret)
+        # Candidate lanes are hashed in-kernel from (global peer id,
+        # slot, round) — no int8[D, R, 128] tensor materialized per
+        # round — and with ``liveness_every > 1`` the whole pass
+        # (including its all_gather on the sharded path) only runs on
+        # sweep rounds, mirroring the reference's probe cadence of one
+        # ping sweep per ~2.6 message intervals (peer.cpp:330 vs 377).
+        blk = min(topo.rowblk, topo.colidx.shape[1])
+
+        def lv_run(ops):
+            col, stk = ops
+            y_alive = prow(gather(alive_w))
+            col2, stk2, evict8 = liveness_pass(
+                y_alive, col, stk, topo.deg, rolls_off, topo.subrolls,
+                gbase=grows[::blk], round_idx=state.round,
+                hash_seed=sim.seed, max_strikes=sim.max_strikes,
+                rowblk=topo.rowblk, interpret=sim.interpret)
+            return col2, stk2, jnp.sum(evict8, dtype=jnp.int32)
+
+        def lv_skip(ops):
+            col, stk = ops
+            return col, stk, jnp.int32(0)
+
+        if sim.liveness_every > 1:
+            colidx, strikes, ev_local = jax.lax.cond(
+                state.round % sim.liveness_every == 0, lv_run, lv_skip,
+                (topo.colidx, strikes))
+        else:
+            colidx, strikes, ev_local = lv_run((topo.colidx, strikes))
         topo = topo.replace(colidx=colidx)
-        n_evict = reduce(jnp.sum(evict8, dtype=jnp.int32))
+        n_evict = reduce(ev_local)
 
     seen_w, frontier_w = state.seen_w, state.frontier_w
     if sim._n_honest < sim.n_msgs:

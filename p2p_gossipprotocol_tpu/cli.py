@@ -302,7 +302,8 @@ def _run_jax_aligned(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
                 churn=sim.churn,
                 byzantine_fraction=sim.byzantine_fraction,
                 n_honest_msgs=sim.n_honest_msgs,
-                max_strikes=sim.max_strikes, seed=sim.seed)
+                max_strikes=sim.max_strikes,
+                liveness_every=sim.liveness_every, seed=sim.seed)
         except ValueError as e:
             print(f"Error: {e}", file=sys.stderr)
             return 1

@@ -83,6 +83,7 @@ class AlignedShardedSimulator:
     byzantine_fraction: float = 0.0
     n_honest_msgs: int | None = None
     max_strikes: int = 3
+    liveness_every: int = 1
     seed: int = 0
     interpret: bool | None = None
 
@@ -103,6 +104,7 @@ class AlignedShardedSimulator:
             fanout=self.fanout,
             churn=self.churn, byzantine_fraction=self.byzantine_fraction,
             n_honest_msgs=self.n_honest_msgs, max_strikes=self.max_strikes,
+            liveness_every=self.liveness_every,
             seed=self.seed, interpret=self.interpret)
         self.churn = self._inner.churn
         self.interpret = self._inner.interpret

@@ -208,25 +208,33 @@ def _numpy_liveness(y_alive, colidx, strikes, rand, deg, rolls, subrolls,
 
 
 def test_liveness_pass_matches_ground_truth():
-    from p2p_gossipprotocol_tpu.ops.aligned_kernel import liveness_pass
+    """The kernel's in-register candidate hash must agree with the jnp
+    reference (rewire_candidates) and the strike/evict/rewire semantics
+    with the numpy ground truth."""
+    from p2p_gossipprotocol_tpu.ops.aligned_kernel import (
+        liveness_pass, rewire_candidates)
 
     rng = np.random.default_rng(13)
     R, D, max_strikes = 16, 4, 3
+    round_idx, seed = 7, 42
     y_alive = np.where(rng.uniform(size=(R, LANES)) < 0.6, -1,
                        0).astype(np.int32)
     colidx = rng.integers(0, LANES, size=(D, R, LANES), dtype=np.int8)
     strikes = rng.integers(0, max_strikes + 2, size=(D, R, LANES),
                            dtype=np.int8)
-    rand = rng.integers(0, LANES, size=(D, R, LANES), dtype=np.int8)
     deg = rng.integers(0, D + 1, size=(R, LANES), dtype=np.int8)
     rolls = rng.integers(0, 2, size=D, dtype=np.int32)
     subrolls = rng.integers(0, 8, size=D, dtype=np.int32)
+    grows = jnp.arange(R, dtype=jnp.int32)
 
     col_k, s_k, ev_k = liveness_pass(
         jnp.asarray(y_alive), jnp.asarray(colidx), jnp.asarray(strikes),
-        jnp.asarray(rand), jnp.asarray(deg), jnp.asarray(rolls),
-        jnp.asarray(subrolls), max_strikes=max_strikes, rowblk=8,
-        interpret=True)
+        jnp.asarray(deg), jnp.asarray(rolls), jnp.asarray(subrolls),
+        gbase=grows[::8], round_idx=round_idx, hash_seed=seed,
+        max_strikes=max_strikes, rowblk=8, interpret=True)
+    rand = np.asarray(rewire_candidates(grows, D, round_idx, seed))
+    assert rand.min() >= 0 and rand.max() < LANES
+    assert len(np.unique(rand)) > LANES // 2     # hash actually spreads
     col_n, s_n, ev_n = _numpy_liveness(
         y_alive, colidx, strikes, rand, deg, rolls, subrolls,
         rowblk=8, max_strikes=max_strikes)
@@ -546,3 +554,51 @@ def test_fanout_deterministic():
     np.testing.assert_array_equal(np.asarray(ra.state.seen_w),
                                   np.asarray(rb.state.seen_w))
     assert ra.coverage[-1] > 0.99
+
+
+# ----------------------------------------------------------------------
+# Strided liveness (the reference's probe cadence: 13 s ping sweeps vs
+# 5 s messages, peer.cpp:330/377 — one sweep per ~2.6 message rounds)
+
+def test_liveness_every_strides_the_pass():
+    """With liveness_every=3 the strike/evict/rewire pass only runs on
+    rounds where round % 3 == 0 — off-rounds must report zero evictions
+    — and the churned network still recovers and converges."""
+    from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+
+    topo = build_aligned(seed=7, n=2048, n_slots=8)
+    sim = AlignedSimulator(topo=topo, n_msgs=8, mode="pushpull",
+                           churn=ChurnConfig(rate=0.05, kill_round=1),
+                           max_strikes=3, liveness_every=3, seed=1)
+    res = sim.run(24)
+    n = topo.n_peers
+    ev = np.asarray(res.evictions)
+    # metrics[i] is the round with pre-increment counter i, so the pass
+    # runs at i % 3 == 0; every other round must be silent
+    off = [i for i in range(24) if i % 3 != 0]
+    assert ev[off].sum() == 0
+    assert ev.sum() > 0                           # sweeps still evict
+    assert n * 0.93 < res.live_peers[-1] < n
+    assert res.coverage[-1] > 0.99                # still converges
+    assert (np.asarray(res.topo.colidx) !=
+            np.asarray(topo.colidx)).any()        # rewire still happens
+
+
+def test_liveness_every_sharded_bitwise(devices8):
+    """The stride composes with the mesh: sharded-vs-unsharded equality
+    stays bitwise with liveness_every > 1."""
+    from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+    from p2p_gossipprotocol_tpu.parallel import (AlignedShardedSimulator,
+                                                 make_mesh)
+
+    topo = build_aligned(seed=9, n=2048, n_slots=6, rowblk=1, n_shards=8)
+    kw = dict(topo=topo, n_msgs=8, mode="pushpull",
+              churn=ChurnConfig(rate=0.05, kill_round=1), max_strikes=2,
+              liveness_every=2, seed=3)
+    ru = AlignedSimulator(**kw).run(10)
+    rs = AlignedShardedSimulator(mesh=make_mesh(8), **kw).run(10)
+    np.testing.assert_array_equal(np.asarray(ru.state.seen_w),
+                                  np.asarray(rs.state.seen_w))
+    np.testing.assert_array_equal(np.asarray(ru.topo.colidx),
+                                  np.asarray(rs.topo.colidx))
+    np.testing.assert_array_equal(ru.evictions, rs.evictions)
