@@ -157,7 +157,8 @@ def _run_jax(cfg: NetworkConfig, args) -> int:
                   f"{int(sim.topo.n_edges())} edges, engine={engine}")
         res = _run_sim(sim, rounds, args)
     _report(res, sim, n_peers=sim.topo.n_peers, engine=engine,
-            args=args, metrics_lib=metrics_lib)
+            args=args, metrics_lib=metrics_lib,
+            graph_backend=cfg.graph_backend)
     return 0
 
 
@@ -175,7 +176,7 @@ def _run_jax_sir(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
               f"{int(sim.topo.n_edges())} edges")
     res = _run_sim(sim, rounds, args)
     _report_sir(res, n_peers=sim.topo.n_peers, engine="edges", args=args,
-                metrics_lib=metrics_lib)
+                metrics_lib=metrics_lib, graph_backend=cfg.graph_backend)
     return 0
 
 
@@ -228,7 +229,7 @@ def _run_jax_sir_aligned(cfg: NetworkConfig, args, rounds,
 
 
 def _report_sir(res, *, n_peers, engine, args, metrics_lib,
-                clamps=None) -> None:
+                clamps=None, graph_backend=None) -> None:
     """Shared SIR census printout + JSONL + summary line (both engines
     return the same SIRResult)."""
     if not args.quiet:
@@ -265,6 +266,8 @@ def _report_sir(res, *, n_peers, engine, args, metrics_lib,
         "total_new_infections": int(res.new_infections.sum()),
         "wall_s": float(res.wall_s),
     }
+    if graph_backend is not None:
+        out["graph_backend"] = graph_backend
     if clamps:
         out["clamped"] = clamps
     print(json.dumps(out))
@@ -320,12 +323,16 @@ def _run_jax_aligned(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
     return 0
 
 
-def _report(res, sim, *, n_peers, engine, args, metrics_lib, clamps=None):
+def _report(res, sim, *, n_peers, engine, args, metrics_lib, clamps=None,
+            graph_backend=None):
     """Shared per-round printout + JSONL + summary line for both engines
     (they return the same SimResult).  ``rounds_run`` is the number of
     rounds the scan actually executed (fixed-length), and the summary's
     ``rounds_to_<target>`` gives convergence; ``clamped`` records any
-    configured value the engine had to reduce."""
+    configured value the engine had to reduce; ``graph_backend`` is
+    recorded for the edge engine because a seed's topology is
+    deterministic within a builder backend, not across them
+    (graph.py:from_config — numpy PCG vs native SplitMix64)."""
     if not args.quiet:
         for i in range(len(res.coverage)):
             print(f"round {i + 1:4d}  coverage={res.coverage[i]:.4f}  "
@@ -349,6 +356,8 @@ def _report(res, sim, *, n_peers, engine, args, metrics_lib, clamps=None):
         "rounds_run": int(len(res.coverage)),
         **summary,
     }
+    if graph_backend is not None:
+        out["graph_backend"] = graph_backend
     if clamps:
         out["clamped"] = clamps
     print(json.dumps(out))
