@@ -602,3 +602,22 @@ def test_liveness_every_sharded_bitwise(devices8):
     np.testing.assert_array_equal(np.asarray(ru.topo.colidx),
                                   np.asarray(rs.topo.colidx))
     np.testing.assert_array_equal(ru.evictions, rs.evictions)
+
+
+def test_from_config_derives_liveness_cadence(tmp_path):
+    """from_config turns the config's own probe/message intervals into
+    the liveness stride — reference defaults (ping 13 s, messages 5 s)
+    give one sweep per 3 rounds; explicit intervals are honored."""
+    from p2p_gossipprotocol_tpu.config import NetworkConfig
+
+    cfg = tmp_path / "net.txt"
+    cfg.write_text("10.0.0.1:8000\nbackend=jax\nengine=aligned\n"
+                   "graph=er\nn_peers=1024\nn_messages=8\n")
+    sim = AlignedSimulator.from_config(NetworkConfig(str(cfg)))
+    assert sim.liveness_every == 3          # round(13 / 5)
+
+    cfg.write_text("10.0.0.1:8000\nbackend=jax\nengine=aligned\n"
+                   "graph=er\nn_peers=1024\nn_messages=8\n"
+                   "ping_interval=5\nmessage_interval=5\n")
+    sim = AlignedSimulator.from_config(NetworkConfig(str(cfg)))
+    assert sim.liveness_every == 1
