@@ -111,3 +111,24 @@ def test_dead_node_notification(tmp_path):
         node.stop()
     finally:
         seed.stop()
+
+
+def test_scripted_demo_framed_wire(tmp_path):
+    """The full scripted story — bootstrap → gossip → SIGKILL a peer →
+    survivors detect death (strike rule over the reader-exit re-probe) →
+    seed eviction — as a subprocess, on the length-framed wire mode
+    (the json mode variant is the README's `python examples/socket_demo.py`).
+    """
+    import os
+    import pathlib
+    import subprocess
+    import sys
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    proc = subprocess.run(
+        [sys.executable, str(repo / "examples" / "socket_demo.py"),
+         "--wire-format", "framed", "--base-port", "23900"],
+        capture_output=True, text=True, timeout=180,
+        env={**os.environ, "PYTHONPATH": str(repo)}, cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "SUCCESS" in proc.stdout
