@@ -131,9 +131,12 @@ def _bench_aligned(n, n_msgs, degree, mode):
     # peer.cpp:330/377).  GOSSIP_BENCH_LIVENESS_EVERY=1 restores a
     # sweep every round.
     liveness_every = int(os.environ.get("GOSSIP_BENCH_LIVENESS_EVERY", "3"))
+    # Distinct block rolls (DMA-reuse layout, build_aligned docstring);
+    # 0 = one per slot (fully random).
+    roll_groups = int(os.environ.get("GOSSIP_BENCH_ROLL_GROUPS", "4")) or None
     t0 = time.perf_counter()
     topo = build_aligned(seed=0, n=n, n_slots=degree,
-                         degree_law="powerlaw")
+                         degree_law="powerlaw", roll_groups=roll_groups)
     graph_s = time.perf_counter() - t0
     sim = AlignedSimulator(topo=topo, n_msgs=n_msgs, mode=mode,
                            churn=ChurnConfig(rate=churn_rate, kill_round=1),
@@ -147,6 +150,7 @@ def _bench_aligned(n, n_msgs, degree, mode):
     bytes_round = sim.hbm_bytes_per_round()
     extras = {
         "liveness_every": liveness_every,
+        "roll_groups": roll_groups,
         # analytic traffic model (aligned.hbm_bytes_per_round) vs the
         # measured wall: how close the engine runs to the ~800 GB/s
         # v5e HBM roof — the round-3 judge's "quantify the gap" ask

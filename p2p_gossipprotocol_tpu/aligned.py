@@ -131,7 +131,8 @@ def build_aligned(seed: int, n: int, n_slots: int = 16,
                   degree_law: str = "regular",
                   powerlaw_alpha: float = 2.5,
                   rowblk: int = 512, n_shards: int = 1,
-                  n_msgs: int = 1) -> AlignedTopology:
+                  n_msgs: int = 1,
+                  roll_groups: int | None = None) -> AlignedTopology:
     """Sample an aligned overlay for ``n`` peers with ``n_slots`` in-edge
     slots per peer.
 
@@ -149,6 +150,17 @@ def build_aligned(seed: int, n: int, n_slots: int = 16,
     row-block groups for AlignedShardedSimulator (1 = single-chip layout;
     the tables are identical for any n_shards that divides the rounded
     row count, so a sharded topo also runs unsharded).
+
+    ``roll_groups`` (None = one roll per slot, the fully-random default)
+    draws only that many DISTINCT block rolls, assigned to contiguous
+    slot groups.  The kernels stream one y block per (row-block, slot);
+    consecutive slots sharing a block roll hit the SAME y block, which
+    the pallas pipeline detects and serves from the resident VMEM buffer
+    instead of re-DMAing — cutting the pass's dominant HBM term from
+    n_slots to roll_groups y streams.  Per-slot sublane rolls and lane
+    choices still differ, and the row permutation already scrambles rows
+    globally, so neighbor draws stay effectively random (convergence
+    parity asserted in tests/test_aligned.py).
     """
     if n_slots > 127:
         raise ValueError("n_slots must fit int8 gating (<= 127)")
@@ -188,7 +200,11 @@ def build_aligned(seed: int, n: int, n_slots: int = 16,
     t_blocks = rows // blk
 
     perm = rng.permutation(rows).astype(np.int32)
-    rolls = rng.integers(0, t_blocks, size=n_slots, dtype=np.int32)
+    n_groups = (n_slots if roll_groups is None
+                else max(1, min(roll_groups, n_slots)))
+    group_rolls = rng.integers(0, t_blocks, size=n_groups, dtype=np.int32)
+    rolls = group_rolls[(np.arange(n_slots) * n_groups)
+                        // n_slots].astype(np.int32)
     subrolls = rng.integers(0, blk, size=n_slots, dtype=np.int32)
     colidx = rng.integers(0, LANES, size=(n_slots, rows, LANES),
                           dtype=np.int8)
@@ -459,8 +475,13 @@ class AlignedSimulator:
         plane = R * LANES * 4            # one int32[R, 128] plane
         word_planes = W * plane          # int32[W, R, 128]
         slot8 = D * R * LANES            # one int8[D, R, 128] table
+        # Effective y streams per pass: consecutive slots sharing a block
+        # roll are served from the resident VMEM buffer (build_aligned
+        # roll_groups), so only roll CHANGES cost a DMA.
+        rolls = np.asarray(self.topo.rolls)
+        y_streams = int(1 + (np.diff(rolls) != 0).sum()) if D > 1 else 1
 
-        gossip_pass_bytes = (D * word_planes      # y streamed per slot
+        gossip_pass_bytes = (y_streams * word_planes  # y per distinct roll
                              + slot8              # colidx
                              + R * LANES          # gate
                              + word_planes)       # OR-accumulator out
@@ -470,7 +491,7 @@ class AlignedSimulator:
         if self.fanout > 0:
             total += R * LANES                    # shift plane
         if self._liveness:
-            liveness = (D * plane                 # alive plane per slot
+            liveness = (y_streams * plane         # alive plane per roll
                         + 4 * slot8               # colidx/strikes r+w
                         + 2 * slot8               # evict8 write + reduce
                         + 3 * plane)              # gather/prep

@@ -621,3 +621,34 @@ def test_from_config_derives_liveness_cadence(tmp_path):
                    "ping_interval=5\nmessage_interval=5\n")
     sim = AlignedSimulator.from_config(NetworkConfig(str(cfg)))
     assert sim.liveness_every == 1
+
+
+def test_roll_groups_convergence_parity():
+    """Grouped block rolls (the DMA-reuse layout) must not slow
+    dissemination: rounds-to-99% within +2 of the fully-random layout on
+    the same scenario."""
+    def rounds_to_99(groups):
+        topo = build_aligned(seed=11, n=65536, n_slots=16,
+                             degree_law="powerlaw", roll_groups=groups)
+        sim = AlignedSimulator(topo=topo, n_msgs=8, mode="pushpull",
+                               seed=2)
+        res = sim.run(16)
+        hit = np.nonzero(res.coverage >= 0.99)[0]
+        assert hit.size, f"groups={groups} never converged"
+        return int(hit[0])
+
+    base = rounds_to_99(None)
+    grouped = rounds_to_99(4)
+    assert grouped <= base + 2, (base, grouped)
+
+
+def test_roll_groups_layout():
+    """roll_groups draws that many distinct block rolls over contiguous
+    slot groups; subrolls/colidx stay per-slot."""
+    topo = build_aligned(seed=3, n=65536, n_slots=16, roll_groups=4,
+                         rowblk=64)        # t_blocks=8: rolls can differ
+    rolls = np.asarray(topo.rolls)
+    assert len(np.unique(rolls[0:4])) == 1
+    assert len(np.unique(rolls[4:8])) == 1
+    groups = {tuple(rolls[i:i + 4]) for i in range(0, 16, 4)}
+    assert len(groups) >= 2          # t_blocks large enough to differ
