@@ -656,22 +656,36 @@ def aligned_coverage(sim: AlignedSimulator, state: AlignedState,
 
 def aligned_round(sim: AlignedSimulator, state: AlignedState,
                   topo: AlignedTopology, *, grows: jax.Array,
-                  t_off: jax.Array, gather, reduce
+                  t_off: jax.Array, gather, reduce,
+                  msg_reduce=None, honest_mask: jax.Array | None = None,
+                  junk_mask: jax.Array | None = None
                   ) -> tuple[AlignedState, AlignedTopology, dict]:
-    """THE round implementation, shared by the single-chip engine and
-    AlignedShardedSimulator (parallel/aligned_sharded.py).
+    """THE round implementation, shared by the single-chip engine,
+    AlignedShardedSimulator (parallel/aligned_sharded.py) and the 2-D
+    peers x message-planes engine (parallel/aligned_2d.py).
 
-    The two callers differ only in how rows map to the global grid:
+    The callers differ only in how rows/planes map to the global grid:
       * ``grows``  — this caller's rows' GLOBAL row ids (per-row RNG keys);
       * ``t_off``  — this caller's first row-block index (offsets the
         kernel's per-slot block rolls);
-      * ``gather`` — identity, or ``all_gather`` over the mesh axis (makes
-        the row-permuted sender/alive words global before the kernels;
-        must gather the ROWS axis, which is ndim-2: axis 0 of the 2D
-        alive words, axis 1 of the 3D message planes);
-      * ``reduce`` — identity, or ``psum`` (metric reduction).
+      * ``gather`` — identity, or ``all_gather`` over the peer mesh axis
+        (makes the row-permuted sender/alive words global before the
+        kernels; must gather the ROWS axis, which is ndim-2: axis 0 of
+        the 2D alive words, axis 1 of the 3D message planes);
+      * ``reduce`` — identity, or ``psum`` over the peer axis (per-PEER
+        metrics: live count, evictions, the coverage denominator);
+      * ``msg_reduce`` — reduction for metrics that also sum over
+        MESSAGE planes (deliveries, the coverage numerator); defaults to
+        ``reduce``; the 2-D engine psums these over both mesh axes;
+      * ``honest_mask``/``junk_mask`` — this caller's slice of the
+        per-plane masks (int32[W_local]); default: the sim's full-width
+        masks (the message axis is unsharded).
     Everything else — churn, strikes/rewire, byzantine, gossip passes,
     metrics — is this one code path, so the engines cannot drift."""
+    if msg_reduce is None:
+        msg_reduce = reduce
+    hmask = sim._honest_mask if honest_mask is None else honest_mask
+    jmask = sim._junk_mask if junk_mask is None else junk_mask
     def prow(x):   # apply the row permutation on the rows (ndim-2) axis
         return jnp.take(x, topo.perm, axis=x.ndim - 2)
 
@@ -727,7 +741,7 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
     if sim._n_honest < sim.n_msgs:
         # Byzantine injection (models/byzantine.py:24-38): junk bits
         # enter every byzantine peer's seen+frontier each round.
-        inject = state.byz_w[None] & sim._junk_mask[:, None, None] & ~seen_w
+        inject = state.byz_w[None] & jmask[:, None, None] & ~seen_w
         seen_w = seen_w | inject
         frontier_w = frontier_w | inject
 
@@ -773,14 +787,14 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
     # In this engine deliveries == frontier bits by construction (every
     # first receipt enters the next frontier); both keys are kept for
     # surface parity with sim.Simulator's metric dict.
-    deliveries = reduce(_popcount_sum(new))
+    deliveries = msg_reduce(_popcount_sum(new))
     # Coverage over honest columns of LIVE HONEST peers — the edge
     # engine's coverage_of (sim.py:33-43).  Each ok peer contributes 32
     # bits to popcount(ok_w), hence the >> 5 peer count.
     ok_w = alive_w & ~state.byz_w & topo.valid_w
     n_ok = jnp.maximum(reduce(_popcount_sum(ok_w)) >> 5, 1)
-    coverage = (reduce(_popcount_sum(
-        seen & ok_w[None] & sim._honest_mask[:, None, None]))
+    coverage = (msg_reduce(_popcount_sum(
+        seen & ok_w[None] & hmask[:, None, None]))
                 .astype(jnp.float32)
                 / (n_ok.astype(jnp.float32) * sim._n_honest))
     live = reduce(_popcount_sum(alive_w & topo.valid_w)) >> 5

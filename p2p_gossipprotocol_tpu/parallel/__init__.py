@@ -7,7 +7,8 @@ network scales by sharding every per-peer state array over a
 dissemination scatter into ICI collectives (SURVEY.md §2, parallelism
 table).  Data parallelism over *peers* is the one parallelism axis the
 capability set needs; message-axis sharding is the nearest analogue of
-sequence parallelism and can be layered on the same mesh.
+sequence parallelism and is layered on the same mesh by the 2-D engine
+(aligned_2d — planes x rows over ``Mesh(("msgs", "peers"))``).
 
 Modules:
   mesh       — mesh construction helpers
@@ -15,8 +16,14 @@ Modules:
   sharded_sim — ShardedSimulator: the whole scan loop under shard_map
   aligned_sharded — AlignedShardedSimulator: the scale engine (pallas
                     kernels + bit-packed words) row-sharded over the mesh
+  aligned_2d — Aligned2DShardedSimulator: message planes x peer rows on
+               a 2-D mesh (the sequence-parallel analogue, SURVEY §2)
 """
 
+from p2p_gossipprotocol_tpu.parallel.aligned_2d import (
+    Aligned2DShardedSimulator,
+    make_mesh_2d,
+)
 from p2p_gossipprotocol_tpu.parallel.aligned_sharded import (
     AlignedShardedSimulator,
     AlignedShardedSIRSimulator,
@@ -32,6 +39,8 @@ from p2p_gossipprotocol_tpu.parallel.sharded_sim import ShardedSimulator
 
 __all__ = [
     "make_mesh",
+    "make_mesh_2d",
+    "Aligned2DShardedSimulator",
     "AlignedShardedSimulator",
     "AlignedShardedSIRSimulator",
     "ShardedTopology",

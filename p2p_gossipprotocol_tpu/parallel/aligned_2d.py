@@ -1,0 +1,212 @@
+"""Aligned2DShardedSimulator — peers x message-planes over a 2-D mesh.
+
+SURVEY §2's parallelism checklist names the message dimension of the
+has-seen matrix as this domain's closest analogue of sequence
+parallelism ("sharding the *message* dimension ... if message count
+grows large").  This engine realizes it: the bit-packed planes
+``int32[W, R, 128]`` shard over a ``Mesh(("msgs", "peers"))`` — rows
+over the peer axis exactly like AlignedShardedSimulator, and the W
+message planes over the msg axis.
+
+Why it composes cleanly: message planes are INDEPENDENT through the
+whole gossip pipeline — the kernels broadcast the same lane tables over
+every plane, OR/AND/popcount are per-plane — so the msg axis needs NO
+collective in the dissemination path at all.  Per round the only
+communication is the same peer-axis ``all_gather`` of the (local-plane)
+send words the 1-D engine does, plus scalar metric ``psum``s: peer
+metrics (live count, evictions, the coverage denominator) reduce over
+the peer axis only, message metrics (deliveries, coverage numerator)
+over both axes.
+
+Shared per-peer state (alive, byzantine, strikes, the rewired lane
+table) is replicated across the msg axis and stays consistent by
+determinism: every msg shard computes bit-identical churn draws (global
+row fold-ins), liveness hashes, and gate draws, so the redundant
+liveness pass per msg shard — the standard sequence-parallel trade —
+cannot diverge.  Asserted bitwise against the unsharded engine
+(tests/test_aligned_2d.py), not statistically.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator, AlignedState,
+                                            AlignedTopology, aligned_round)
+from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+from p2p_gossipprotocol_tpu.parallel.mesh import PEER_AXIS
+
+MSG_AXIS = "msgs"
+
+
+def make_mesh_2d(n_msg_shards: int, n_peer_shards: int,
+                 devices=None) -> Mesh:
+    """(msgs, peers) mesh over the first n_msg*n_peer devices."""
+    devices = jax.devices() if devices is None else devices
+    need = n_msg_shards * n_peer_shards
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    grid = np.asarray(devices[:need]).reshape(n_msg_shards, n_peer_shards)
+    return Mesh(grid, (MSG_AXIS, PEER_AXIS))
+
+
+def _topo_spec(topo: AlignedTopology) -> AlignedTopology:
+    return topo.replace(
+        perm=P(), rolls=P(), subrolls=P(),
+        colidx=P(None, PEER_AXIS, None), deg=P(PEER_AXIS, None),
+        valid_w=P(PEER_AXIS, None))
+
+
+def _state_spec(liveness: bool) -> AlignedState:
+    return AlignedState(
+        seen_w=P(MSG_AXIS, PEER_AXIS, None),
+        frontier_w=P(MSG_AXIS, PEER_AXIS, None),
+        alive_b=P(PEER_AXIS, None), byz_w=P(PEER_AXIS, None),
+        strikes=P(None, PEER_AXIS, None) if liveness else None,
+        key=P(), round=P())
+
+
+@dataclass
+class Aligned2DShardedSimulator:
+    """Drop-in 2-D counterpart of :class:`aligned.AlignedSimulator`:
+    same constructor surface plus the mesh split, same SimResult."""
+
+    topo: AlignedTopology
+    n_msg_shards: int = 2
+    n_peer_shards: int = 4
+    mesh: Mesh = None            # default: make_mesh_2d over jax.devices()
+    n_msgs: int = 64
+    mode: str = "push"
+    fanout: int = 0
+    churn: ChurnConfig = None    # type: ignore[assignment]
+    byzantine_fraction: float = 0.0
+    n_honest_msgs: int | None = None
+    max_strikes: int = 3
+    liveness_every: int = 1
+    seed: int = 0
+    interpret: bool | None = None
+
+    def __post_init__(self):
+        if self.mesh is None:
+            self.mesh = make_mesh_2d(self.n_msg_shards, self.n_peer_shards)
+        self.n_msg_shards, self.n_peer_shards = self.mesh.devices.shape
+        # The unsharded engine IS the semantics (same discipline as the
+        # 1-D engine): validation, init_state, masks come from it.
+        self._inner = AlignedSimulator(
+            topo=self.topo, n_msgs=self.n_msgs, mode=self.mode,
+            fanout=self.fanout, churn=self.churn,
+            byzantine_fraction=self.byzantine_fraction,
+            n_honest_msgs=self.n_honest_msgs, max_strikes=self.max_strikes,
+            liveness_every=self.liveness_every, seed=self.seed,
+            interpret=self.interpret)
+        self.churn = self._inner.churn
+        self.interpret = self._inner.interpret
+        self._liveness = self._inner._liveness
+        W = self._inner.n_words
+        if W % self.n_msg_shards:
+            raise ValueError(
+                f"{self.n_msgs} messages pack into {W} planes, which do "
+                f"not split over {self.n_msg_shards} message shards — "
+                f"use n_msgs a multiple of {32 * self.n_msg_shards}")
+        rows, blk = self.topo.rows, self.topo.rowblk
+        if rows % (self.n_peer_shards * blk):
+            raise ValueError(
+                f"{rows} rows (rowblk {blk}) do not split over "
+                f"{self.n_peer_shards} peer shards — build the overlay "
+                f"with build_aligned(..., n_shards={self.n_peer_shards})")
+        self._run_cache: dict = {}
+
+    # ------------------------------------------------------------------
+    def init_state(self) -> AlignedState:
+        state = self._inner.init_state()
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s),
+            _state_spec(self._liveness),
+            is_leaf=lambda x: isinstance(x, P))
+        return jax.device_put(state, shardings)
+
+    def shard_topo(self, topo: AlignedTopology | None = None
+                   ) -> AlignedTopology:
+        topo = self.topo if topo is None else topo
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), _topo_spec(topo),
+            is_leaf=lambda x: isinstance(x, P))
+        return jax.device_put(topo, shardings)
+
+    # ------------------------------------------------------------------
+    def _step_local(self, state: AlignedState, topo: AlignedTopology):
+        rows_l = state.seen_w.shape[1]
+        pidx = jax.lax.axis_index(PEER_AXIS)
+        grow0 = pidx * rows_l
+        grows = grow0 + jnp.arange(rows_l, dtype=jnp.int32)
+        t_off = (grow0 // topo.rowblk).astype(jnp.int32)
+        # This shard's slice of the per-plane masks.
+        w_local = state.seen_w.shape[0]
+        w0 = jax.lax.axis_index(MSG_AXIS) * w_local
+        hmask = jax.lax.dynamic_slice(self._inner._honest_mask, (w0,),
+                                      (w_local,))
+        jmask = jax.lax.dynamic_slice(self._inner._junk_mask, (w0,),
+                                      (w_local,))
+        return aligned_round(
+            self._inner, state, topo, grows=grows, t_off=t_off,
+            gather=lambda x: jax.lax.all_gather(x, PEER_AXIS,
+                                                axis=x.ndim - 2,
+                                                tiled=True),
+            reduce=lambda x: jax.lax.psum(x, PEER_AXIS),
+            msg_reduce=lambda x: jax.lax.psum(x, (MSG_AXIS, PEER_AXIS)),
+            honest_mask=hmask, junk_mask=jmask)
+
+    # ------------------------------------------------------------------
+    def run(self, rounds: int, state: AlignedState | None = None,
+            topo: AlignedTopology | None = None, warmup: bool = False):
+        """Fixed-round scan inside one shard_map over the 2-D mesh; the
+        shared :class:`sim.SimResult` (same warmup contract as every
+        other scale-path run())."""
+        import time as _time
+
+        from p2p_gossipprotocol_tpu.sim import SimResult
+
+        state = self.init_state() if state is None else state
+        topo = self.shard_topo(topo)
+        if rounds not in self._run_cache:
+            st_spec = _state_spec(self._liveness)
+            tp_spec = _topo_spec(self.topo)
+            metric_spec = {k: P() for k in ("coverage", "deliveries",
+                                            "frontier_size", "live_peers",
+                                            "evictions")}
+
+            def scanned(st, tp):
+                def body(carry, _):
+                    s, t = carry
+                    s, t, metrics = self._step_local(s, t)
+                    return (s, t), metrics
+                return jax.lax.scan(body, (st, tp), None, length=rounds)
+
+            self._run_cache[rounds] = jax.jit(jax.shard_map(
+                scanned, mesh=self.mesh,
+                in_specs=(st_spec, tp_spec),
+                out_specs=((st_spec, tp_spec), metric_spec),
+                check_vma=False))
+        fn = self._run_cache[rounds]
+        if warmup:
+            (w_state, _), _ = fn(state, topo)
+            int(jax.device_get(w_state.round))
+        t0 = _time.perf_counter()
+        (state, topo), ys = fn(state, topo)
+        int(jax.device_get(state.round))
+        wall = _time.perf_counter() - t0
+        return SimResult(
+            state=state, topo=topo,
+            coverage=np.asarray(ys["coverage"]),
+            deliveries=np.asarray(ys["deliveries"]),
+            frontier_size=np.asarray(ys["frontier_size"]),
+            live_peers=np.asarray(ys["live_peers"]),
+            evictions=np.asarray(ys["evictions"]),
+            wall_s=wall,
+        )
