@@ -148,3 +148,28 @@ def test_run_with_checkpoints_sharded(tmp_path, devices8):
                                   np.asarray(full.state.seen_w))
     np.testing.assert_array_equal(np.asarray(resumed.topo.colidx),
                                   np.asarray(full.topo.colidx))
+
+
+def test_run_with_checkpoints_sir(tmp_path):
+    """The runner's claim covers the SIR engines too: an interrupted
+    epidemic census resumes into the same curve an uninterrupted run
+    produces."""
+    from p2p_gossipprotocol_tpu import graph
+    from p2p_gossipprotocol_tpu.sim import SIRSimulator
+
+    topo = graph.erdos_renyi(seed=1, n=2000, avg_degree=8)
+
+    def mk():
+        return SIRSimulator(topo=topo, beta=0.3, gamma=0.1, n_seeds=5,
+                            seed=2)
+
+    full = mk().run(12)
+    d = str(tmp_path / "ck")
+    checkpoint.run_with_checkpoints(mk(), 6, every=3, directory=d)
+    resumed = checkpoint.run_with_checkpoints(mk(), 12, every=3,
+                                              directory=d, resume=True)
+    np.testing.assert_array_equal(resumed.infected, full.infected)
+    np.testing.assert_array_equal(resumed.new_infections,
+                                  full.new_infections)
+    np.testing.assert_array_equal(np.asarray(resumed.state.infected),
+                                  np.asarray(full.state.infected))
