@@ -438,7 +438,8 @@ class AlignedSimulator:
         topo = build_aligned(seed=cfg.prng_seed, n=n, n_slots=n_slots,
                              degree_law=law,
                              powerlaw_alpha=cfg.powerlaw_alpha,
-                             n_shards=n_shards, n_msgs=n_msgs)
+                             n_shards=n_shards, n_msgs=n_msgs,
+                             roll_groups=cfg.roll_groups or None)
         return cls(topo=topo, n_msgs=n_msgs, mode=cfg.mode,
                    fanout=cfg.fanout,
                    churn=ChurnConfig(rate=cfg.churn_rate),

@@ -202,7 +202,8 @@ def _run_jax_sir_aligned(cfg: NetworkConfig, args, rounds,
         topo = build_aligned(seed=cfg.prng_seed, n=n, n_slots=n_slots,
                              degree_law=law,
                              powerlaw_alpha=cfg.powerlaw_alpha,
-                             n_shards=n_shards)
+                             n_shards=n_shards,
+                             roll_groups=cfg.roll_groups or None)
         kw = dict(topo=topo, beta=cfg.sir_beta, gamma=cfg.sir_gamma,
                   churn=ChurnConfig(rate=cfg.churn_rate),
                   seed=cfg.prng_seed)

@@ -100,6 +100,10 @@ _SIM_INT_KEYS = {
     "avg_degree": "avg_degree",
     "ba_m": "ba_m",
     "fanout": "fanout",
+    # aligned engine: distinct block rolls in the overlay (0 = one per
+    # slot); small values let the kernels reuse resident y blocks
+    # across slots (build_aligned docstring).
+    "roll_groups": "roll_groups",
     "rounds": "rounds",
     "prng_seed": "prng_seed",
     # Socket mode: seconds between anti-entropy pulls (0 = off, the
@@ -155,6 +159,7 @@ class NetworkConfig:
         self.ba_m = 4
         self.er_p = 0.0
         self.fanout = 0
+        self.roll_groups = 0           # aligned engine; 0 = per-slot rolls
         self.rounds = 0
         self.churn_rate = 0.0
         self.byzantine_fraction = 0.0
@@ -278,7 +283,8 @@ class NetworkConfig:
         if not is_valid_port(self.local_port):
             raise ConfigError(f"Invalid local_port: {self.local_port}")
         for k in ("n_peers", "n_messages", "avg_degree", "ba_m", "fanout",
-                  "rounds", "prng_seed", "anti_entropy_interval"):
+                  "roll_groups", "rounds", "prng_seed",
+                  "anti_entropy_interval"):
             if getattr(self, k) < 0:
                 raise ConfigError(f"{k} must be non-negative")
         if self.backend not in ("jax", "socket"):

@@ -213,3 +213,12 @@ def test_engine_key_default_and_invalid(tmp_path):
     cfg.write_text("10.0.0.1:8000\nengine=warp\n")
     with pytest.raises(ConfigError, match="Unknown engine"):
         NetworkConfig(str(cfg))
+
+
+def test_roll_groups_key(tmp_path):
+    from p2p_gossipprotocol_tpu.config import NetworkConfig
+    cfg = tmp_path / "net.txt"
+    cfg.write_text("10.0.0.1:8000\nroll_groups=4\n")
+    assert NetworkConfig(str(cfg)).roll_groups == 4
+    cfg.write_text("10.0.0.1:8000\n")
+    assert NetworkConfig(str(cfg)).roll_groups == 0
