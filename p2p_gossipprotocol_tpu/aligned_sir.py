@@ -90,6 +90,31 @@ class AlignedSIRSimulator:
         self._scan_cache: dict = {}
 
     # ------------------------------------------------------------------
+    @classmethod
+    def from_config(cls, cfg, n_peers: int | None = None,
+                    n_shards: int = 1,
+                    clamps: list[str] | None = None
+                    ) -> "AlignedSIRSimulator":
+        """Build the scale-path SIR engine from a parsed NetworkConfig —
+        shared by the CLI's ``--mode sir --engine aligned`` and the
+        wrapper facade (mirrors AlignedSimulator.from_config; same
+        resolve_overlay clamping contract)."""
+        from p2p_gossipprotocol_tpu.aligned import (build_aligned,
+                                                    resolve_overlay)
+
+        clamps = clamps if clamps is not None else []
+        n, law, n_slots = resolve_overlay(cfg, n_peers=n_peers,
+                                          clamps=clamps)
+        topo = build_aligned(seed=cfg.prng_seed, n=n, n_slots=n_slots,
+                             degree_law=law,
+                             powerlaw_alpha=cfg.powerlaw_alpha,
+                             n_shards=n_shards,
+                             roll_groups=cfg.roll_groups or None)
+        return cls(topo=topo, beta=cfg.sir_beta, gamma=cfg.sir_gamma,
+                   churn=ChurnConfig(rate=cfg.churn_rate),
+                   seed=cfg.prng_seed)
+
+    # ------------------------------------------------------------------
     def init_state(self) -> AlignedSIRState:
         """Seed infections spread evenly over the peer population (the
         deterministic analogue of init_sir_state's uniform choice)."""

@@ -184,44 +184,37 @@ def _run_jax_sir_aligned(cfg: NetworkConfig, args, rounds,
                          metrics_lib) -> int:
     """BASELINE config 3 on the scale path: the aligned overlay's SIR
     engine (aligned_sir.py), single-chip or sharded over --mesh-devices."""
-    from p2p_gossipprotocol_tpu.aligned import build_aligned, resolve_overlay
     from p2p_gossipprotocol_tpu.aligned_sir import AlignedSIRSimulator
-    from p2p_gossipprotocol_tpu.liveness import ChurnConfig
 
     clamps: list[str] = []
+    n_shards = max(1, args.mesh_devices)
     try:
-        n, law, n_slots = resolve_overlay(cfg, n_peers=args.n_peers,
-                                          clamps=clamps)
+        sim = AlignedSIRSimulator.from_config(cfg, n_peers=args.n_peers,
+                                              n_shards=n_shards,
+                                              clamps=clamps)
     except ValueError as e:
         print(f"Error: {e}", file=sys.stderr)
         return 1
     for c in clamps:
         print(f"Warning: --engine aligned clamped {c}", file=sys.stderr)
-    n_shards = max(1, args.mesh_devices)
-    try:
-        topo = build_aligned(seed=cfg.prng_seed, n=n, n_slots=n_slots,
-                             degree_law=law,
-                             powerlaw_alpha=cfg.powerlaw_alpha,
-                             n_shards=n_shards,
-                             roll_groups=cfg.roll_groups or None)
-        kw = dict(topo=topo, beta=cfg.sir_beta, gamma=cfg.sir_gamma,
-                  churn=ChurnConfig(rate=cfg.churn_rate),
-                  seed=cfg.prng_seed)
-        if n_shards > 1:
-            from p2p_gossipprotocol_tpu.parallel import (
-                AlignedShardedSIRSimulator, make_mesh)
+    engine = "aligned"
+    if n_shards > 1:
+        from p2p_gossipprotocol_tpu.parallel import (
+            AlignedShardedSIRSimulator, make_mesh)
 
-            sim = AlignedShardedSIRSimulator(mesh=make_mesh(n_shards), **kw)
-            engine = f"aligned-sharded-{n_shards}"
-        else:
-            sim = AlignedSIRSimulator(**kw)
-            engine = "aligned"
-    except ValueError as e:
-        print(f"Error: {e}", file=sys.stderr)
-        return 1
+        try:
+            sim = AlignedShardedSIRSimulator(
+                mesh=make_mesh(n_shards), topo=sim.topo, beta=sim.beta,
+                gamma=sim.gamma, n_seeds=sim.n_seeds, churn=sim.churn,
+                seed=sim.seed)
+        except ValueError as e:
+            print(f"Error: {e}", file=sys.stderr)
+            return 1
+        engine = f"aligned-sharded-{n_shards}"
+    n = sim.topo.n_peers
     if not args.quiet:
         print(f"[jax/sir] simulating {n} peers, beta={cfg.sir_beta:g}, "
-              f"gamma={cfg.sir_gamma:g}, {topo.n_slots} slots/peer, "
+              f"gamma={cfg.sir_gamma:g}, {sim.topo.n_slots} slots/peer, "
               f"engine={engine}")
     res = _run_sim(sim, rounds, args)
     _report_sir(res, n_peers=n, engine=engine, args=args,

@@ -52,14 +52,26 @@ class Peer:
             #: only; surfaced, never silent — same contract as the CLI)
             self.clamps: list[str] = []
             if cfg.engine == "aligned":
-                # The scale engine (1M+ peers) through the same
+                # The scale engines (1M+ peers) through the same
                 # reference-parity facade — engine= in the config file
                 # is all it takes (round-3 judge: the facade previously
                 # always built the edges engine).
-                from p2p_gossipprotocol_tpu.aligned import AlignedSimulator
+                if cfg.mode == "sir":
+                    from p2p_gossipprotocol_tpu.aligned_sir import \
+                        AlignedSIRSimulator
 
-                self._sim = AlignedSimulator.from_config(
-                    cfg, clamps=self.clamps)
+                    self._sim = AlignedSIRSimulator.from_config(
+                        cfg, clamps=self.clamps)
+                else:
+                    from p2p_gossipprotocol_tpu.aligned import \
+                        AlignedSimulator
+
+                    self._sim = AlignedSimulator.from_config(
+                        cfg, clamps=self.clamps)
+            elif cfg.mode == "sir":
+                from p2p_gossipprotocol_tpu.sim import SIRSimulator
+
+                self._sim = SIRSimulator.from_config(cfg)
             else:
                 from p2p_gossipprotocol_tpu.sim import Simulator
 
@@ -88,35 +100,38 @@ class Peer:
         # partial chunk (rounds % JAX_ROUND_CHUNK) compiles once more,
         # and that compile time lands in the summed wall_s.
         def _run():
+            import dataclasses
+            import inspect
+
             import numpy as np
 
-            from p2p_gossipprotocol_tpu.sim import SimResult
-
-            state, topo, parts, wall, done = None, None, [], 0.0, 0
+            # Result-type agnostic (SimResult and SIRResult both carry
+            # state/topo/wall_s plus per-round history arrays), so every
+            # engine x mode the config can name runs through this one
+            # chunked loop.
+            takes_topo = "topo" in inspect.signature(
+                self._sim.run).parameters
+            state, topo, hist, wall, done = None, None, None, 0.0, 0
+            result_cls = None
             try:
                 while done < rounds and not self._stop_event.is_set():
                     step = min(self.JAX_ROUND_CHUNK, rounds - done)
-                    r = self._sim.run(step, state=state, topo=topo)
-                    parts.append(r)
+                    kw = {"topo": topo} if takes_topo else {}
+                    r = self._sim.run(step, state=state, **kw)
+                    result_cls = type(r)
                     state, topo = r.state, r.topo
+                    part = {f.name: getattr(r, f.name)
+                            for f in dataclasses.fields(r)
+                            if f.name not in ("state", "topo", "wall_s")}
+                    hist = part if hist is None else {
+                        k: np.concatenate([hist[k], part[k]])
+                        for k in part}
                     wall += r.wall_s
                     done += step
                     self.rounds_completed = done
-                if parts:
-                    self._result = SimResult(
-                        state=state, topo=topo,
-                        coverage=np.concatenate(
-                            [p.coverage for p in parts]),
-                        deliveries=np.concatenate(
-                            [p.deliveries for p in parts]),
-                        frontier_size=np.concatenate(
-                            [p.frontier_size for p in parts]),
-                        live_peers=np.concatenate(
-                            [p.live_peers for p in parts]),
-                        evictions=np.concatenate(
-                            [p.evictions for p in parts]),
-                        wall_s=wall,
-                    )
+                if result_cls is not None:
+                    self._result = result_cls(state=state, topo=topo,
+                                              wall_s=wall, **hist)
             except Exception as e:  # noqa: BLE001 — surface via join()
                 # Without this, a mid-chunk failure (trace error, OOM)
                 # would leave is_running() True forever and join() would

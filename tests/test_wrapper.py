@@ -92,3 +92,21 @@ def test_facade_aligned_engine_surfaces_clamps(tmp_path):
                    "n_messages=16\nrounds=4\nprng_seed=0\n")
     peer = Peer(str(cfg))
     assert any("ba" in c for c in peer.clamps)
+
+
+def test_facade_runs_sir_mode(tmp_path):
+    """mode=sir on the facade: the chunked runner is result-type
+    agnostic, so the epidemic census rides the same start/join
+    lifecycle (edges and aligned engines both)."""
+    for engine, n in (("edges", 512), ("aligned", 1024)):
+        cfg = tmp_path / f"net_{engine}.txt"
+        cfg.write_text("10.0.0.1:8000\n"
+                       f"backend=jax\nengine={engine}\ngraph=er\n"
+                       f"n_peers={n}\nmode=sir\nrounds=12\nprng_seed=0\n")
+        peer = Peer(str(cfg))
+        assert peer.start()
+        result = peer.join(timeout=300)
+        assert result is not None, engine
+        assert len(result.infected) == 12
+        assert int(result.new_infections.sum()) > 0, engine
+        assert not peer.is_running()
