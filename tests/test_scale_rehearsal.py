@@ -47,3 +47,26 @@ def test_config5_rehearsal_128k_rows(devices8):
     reason="opt-in scale rehearsal (set GOSSIP_SCALE_TESTS=1)")
 def test_config5_rehearsal_1m_rows(devices8):
     _run_config5(1 << 20, rounds=24)
+
+
+def test_config5_rehearsal_2d_mesh(devices8):
+    """Config-5 feature set on the 2-D (message planes x peers) mesh at
+    128k rows: 64 messages as 2 plane shards x 4 peer shards, churn +
+    byzantine + eviction, CI-default."""
+    from p2p_gossipprotocol_tpu.aligned import build_aligned
+    from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+    from p2p_gossipprotocol_tpu.parallel import (Aligned2DShardedSimulator,
+                                                 make_mesh_2d)
+
+    rows = 1 << 17
+    topo = build_aligned(seed=0, n=rows, n_slots=8,
+                         degree_law="powerlaw", n_shards=4, n_msgs=64)
+    sim = Aligned2DShardedSimulator(
+        topo=topo, mesh=make_mesh_2d(2, 4), n_msgs=64, mode="pushpull",
+        churn=ChurnConfig(rate=0.05, kill_round=1),
+        byzantine_fraction=0.1, n_honest_msgs=48, max_strikes=3,
+        liveness_every=2, seed=0)
+    res = sim.run(24)
+    assert float(res.coverage[-1]) >= 0.99
+    assert int(np.asarray(res.evictions).sum()) > 0
+    assert int(res.live_peers[-1]) < rows * 0.97
