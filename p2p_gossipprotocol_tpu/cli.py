@@ -60,6 +60,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="jax mode: shard the peer axis over an N-device "
                         "mesh (ShardedSimulator / "
                         "AlignedShardedSimulator); 0 = single device")
+    p.add_argument("--msg-shards", type=int, default=0, metavar="M",
+                   help="with --engine aligned and --mesh-devices N: "
+                        "also shard the message planes, as an "
+                        "M x (N/M) (msgs x peers) 2-D mesh "
+                        "(Aligned2DShardedSimulator); 0 = peers only")
     p.add_argument("--target-coverage", type=float, default=0.99)
     p.add_argument("--local-ip", default=None)
     p.add_argument("--local-port", type=int, default=None)
@@ -289,22 +294,40 @@ def _run_jax_aligned(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
         print(f"Warning: --engine aligned clamped {c}", file=sys.stderr)
     engine = "aligned"
     if n_shards > 1:
-        from p2p_gossipprotocol_tpu.parallel import (
-            AlignedShardedSimulator, make_mesh)
-
+        lifted = dict(
+            topo=sim.topo, n_msgs=sim.n_msgs, mode=sim.mode,
+            fanout=sim.fanout, churn=sim.churn,
+            byzantine_fraction=sim.byzantine_fraction,
+            n_honest_msgs=sim.n_honest_msgs,
+            max_strikes=sim.max_strikes,
+            liveness_every=sim.liveness_every, seed=sim.seed)
         try:
-            sim = AlignedShardedSimulator(
-                mesh=make_mesh(n_shards), topo=sim.topo,
-                n_msgs=sim.n_msgs, mode=sim.mode, fanout=sim.fanout,
-                churn=sim.churn,
-                byzantine_fraction=sim.byzantine_fraction,
-                n_honest_msgs=sim.n_honest_msgs,
-                max_strikes=sim.max_strikes,
-                liveness_every=sim.liveness_every, seed=sim.seed)
+            if args.msg_shards > 1:
+                # 2-D mesh: message planes x peer rows (the SP analogue,
+                # parallel/aligned_2d.py)
+                from p2p_gossipprotocol_tpu.parallel import (
+                    Aligned2DShardedSimulator, make_mesh_2d)
+
+                if n_shards % args.msg_shards:
+                    print(f"Error: --msg-shards {args.msg_shards} does "
+                          f"not divide --mesh-devices {n_shards}",
+                          file=sys.stderr)
+                    return 1
+                peer_shards = n_shards // args.msg_shards
+                sim = Aligned2DShardedSimulator(
+                    mesh=make_mesh_2d(args.msg_shards, peer_shards),
+                    **lifted)
+                engine = (f"aligned-2d-{args.msg_shards}x{peer_shards}")
+            else:
+                from p2p_gossipprotocol_tpu.parallel import (
+                    AlignedShardedSimulator, make_mesh)
+
+                sim = AlignedShardedSimulator(
+                    mesh=make_mesh(n_shards), **lifted)
+                engine = f"aligned-sharded-{n_shards}"
         except ValueError as e:
             print(f"Error: {e}", file=sys.stderr)
             return 1
-        engine = f"aligned-sharded-{n_shards}"
     n = sim.topo.n_peers
     if not args.quiet:
         print(f"[jax/aligned] simulating {n} peers, {sim.n_msgs} "
@@ -416,6 +439,13 @@ def main(argv: list[str] | None = None) -> int:
         cfg.engine = args.engine
     args.engine = cfg.engine
 
+    if args.msg_shards > 1 and (cfg.engine != "aligned"
+                                or args.mesh_devices <= 1
+                                or cfg.mode == "sir"):
+        print("Error: --msg-shards needs --engine aligned, "
+              "--mesh-devices > 1, and a gossip mode (the 2-D mesh "
+              "shards the bit-packed message planes)", file=sys.stderr)
+        return 1
     if (args.checkpoint_every > 0 or args.resume) \
             and not args.checkpoint_dir:
         print("Error: --checkpoint-every/--resume need --checkpoint-dir",

@@ -287,3 +287,30 @@ def test_cli_reports_graph_backend():
     assert proc.returncode == 0, proc.stderr
     result = json.loads(proc.stdout.strip().splitlines()[-1])
     assert result["graph_backend"] in ("numpy", "native")
+
+
+def test_cli_2d_mesh_engine(tmp_path):
+    """--mesh-devices 8 --msg-shards 2 routes onto the 2-D
+    (message planes x peers) engine; bad combinations are rejected."""
+    env = {"PYTHONPATH": str(REPO_ROOT), "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+           "PATH": "/usr/bin:/bin:/usr/local/bin"}
+    cfg = tmp_path / "net.txt"
+    cfg.write_text("10.0.0.1:8000\nbackend=jax\nengine=aligned\n"
+                   "graph=er\nn_peers=2048\navg_degree=6\n"
+                   "mode=pushpull\nn_messages=64\nrounds=4\n")
+    base = [sys.executable, "-m", "p2p_gossipprotocol_tpu.cli",
+            str(cfg), "--quiet"]
+    proc = subprocess.run(base + ["--mesh-devices", "8",
+                                  "--msg-shards", "2"],
+                          capture_output=True, text=True, timeout=600,
+                          env=env, cwd=str(REPO_ROOT))
+    assert proc.returncode == 0, proc.stderr
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["engine"] == "aligned-2d-2x4"
+
+    proc = subprocess.run(base + ["--msg-shards", "2"],
+                          capture_output=True, text=True, timeout=120,
+                          env=env, cwd=str(REPO_ROOT))
+    assert proc.returncode == 1
+    assert "--msg-shards needs" in proc.stderr
