@@ -173,3 +173,26 @@ def test_run_with_checkpoints_sir(tmp_path):
                                   full.new_infections)
     np.testing.assert_array_equal(np.asarray(resumed.state.infected),
                                   np.asarray(full.state.infected))
+
+
+def test_run_with_checkpoints_2d_mesh(tmp_path, devices8):
+    """Checkpoint/resume across the 2-D (msgs x peers) mesh."""
+    from p2p_gossipprotocol_tpu.parallel import (Aligned2DShardedSimulator,
+                                                 make_mesh_2d)
+
+    topo = build_aligned(seed=5, n=2048, n_slots=6, rowblk=1, n_shards=4)
+
+    def mk():
+        return Aligned2DShardedSimulator(
+            topo=topo, mesh=make_mesh_2d(2, 4), n_msgs=64,
+            mode="pushpull", churn=ChurnConfig(rate=0.05, kill_round=1),
+            max_strikes=2, seed=3)
+
+    full = mk().run(8)
+    d = str(tmp_path / "ck")
+    checkpoint.run_with_checkpoints(mk(), 4, every=4, directory=d)
+    resumed = checkpoint.run_with_checkpoints(mk(), 8, every=4,
+                                              directory=d, resume=True)
+    np.testing.assert_array_equal(resumed.coverage, full.coverage)
+    np.testing.assert_array_equal(np.asarray(resumed.state.seen_w),
+                                  np.asarray(full.state.seen_w))
