@@ -40,43 +40,29 @@ def restore(path: str, target):
     return restored
 
 
-def run_with_checkpoints(sim, rounds: int, *, every: int, directory: str,
-                         resume: bool = False):
-    """Drive ``sim.run`` in ``every``-round chunks, persisting the whole
-    mutable world after each chunk; with ``resume=True``, continue from
-    the checkpoint in ``directory``.
+def run_chunked(sim, rounds: int, *, every: int, state=None, topo=None,
+                hist=None, wall: float = 0.0, done: int = 0,
+                after_chunk=None, should_stop=None):
+    """Drive ``sim.run`` in ``every``-round chunks — the shared core
+    under :func:`run_with_checkpoints` and wrapper.Peer's jax thread.
 
-    Works with every engine exposing the run()/init_state() surface
-    (edges, aligned, both sharded variants, both SIR engines).  The
-    device state + topology go through orbax (:func:`save`); the
-    host-side metric history and round/wall counters ride a ``.npz``
-    sidecar, so a resumed run returns the SAME result an uninterrupted
-    ``sim.run(rounds)`` would: bitwise-identical state (the PRNG chain
-    and round counter live in the pytree) and the full metric history —
-    the kill-and-resume contract SURVEY §5 promises.
+    Result-type agnostic: works with every engine exposing the
+    run()/init_state() surface (edges, aligned, 1-D/2-D sharded, both
+    SIR engines) — history fields are harvested from the result
+    dataclass, so the two callers cannot drift.
+
+    Returns ``(result, state, topo, hist, wall, done)`` where ``result``
+    is the rebuilt result object covering rounds [0, done), or None if
+    no chunk ran AND no prior history was supplied.
     """
     import dataclasses
     import inspect
 
     import numpy as np
 
-    os.makedirs(directory, exist_ok=True)
-    state_dir = os.path.join(directory, "state")
-    hist_path = os.path.join(directory, "history.npz")
     takes_topo = "topo" in inspect.signature(sim.run).parameters
-
-    state = topo = hist = result_cls = None
-    done, wall = 0, 0.0
-    if resume and os.path.exists(hist_path):
-        target = {"state": sim.init_state(), "topo": sim.topo}
-        restored = restore(state_dir, target)
-        state, topo = restored["state"], restored["topo"]
-        with np.load(hist_path) as m:
-            hist = {k: m[k][:rounds] for k in m.files
-                    if k not in ("rounds_done", "wall_s")}
-            done = min(int(m["rounds_done"]), rounds)
-            wall = float(m["wall_s"])
-    while done < rounds:
+    result_cls = None
+    while done < rounds and not (should_stop() if should_stop else False):
         step = min(every, rounds - done)
         kw = {"topo": topo} if takes_topo else {}
         r = sim.run(step, state=state, **kw)
@@ -88,13 +74,83 @@ def run_with_checkpoints(sim, rounds: int, *, every: int, directory: str,
             {k: np.concatenate([hist[k], part[k]]) for k in part}
         wall += float(r.wall_s)
         done += step
-        save(state_dir, {"state": state, "topo": topo})
-        np.savez(hist_path, rounds_done=done, wall_s=wall, **hist)
+        if after_chunk is not None:
+            after_chunk(state, topo, hist, wall, done)
     if result_cls is None:
-        # resumed at/past the requested round count: nothing ran this
-        # process; rebuild the result type from the stored history shape
+        if hist is None:
+            return None, state, topo, hist, wall, done
+        # nothing ran this process (resume already at the requested
+        # round count): rebuild the result type from the history shape
         from p2p_gossipprotocol_tpu.sim import SimResult, SIRResult
 
         result_cls = SimResult if "coverage" in hist else SIRResult
         topo = sim.topo if topo is None else topo
-    return result_cls(state=state, topo=topo, wall_s=wall, **hist)
+    result = result_cls(state=state, topo=topo, wall_s=wall, **hist)
+    return result, state, topo, hist, wall, done
+
+
+def run_with_checkpoints(sim, rounds: int, *, every: int, directory: str,
+                         resume: bool = False):
+    """:func:`run_chunked` with the whole mutable world persisted after
+    each chunk; with ``resume=True``, continue from the checkpoint in
+    ``directory``.
+
+    The device state + topology go through orbax (:func:`save`); the
+    host-side metric history and round/wall counters ride a ``.npz``
+    sidecar, so a resumed run returns the SAME result an uninterrupted
+    ``sim.run(rounds)`` would: bitwise-identical state (the PRNG chain
+    and round counter live in the pytree) and the full metric history —
+    the kill-and-resume contract SURVEY §5 promises.
+
+    Crash-atomic by construction: each chunk saves to a fresh
+    ``state_<round>`` directory, the sidecar is written to a temp file
+    and ``os.replace``d (atomic) only after the state landed, and stale
+    state dirs are pruned last.  A kill at ANY point leaves the sidecar
+    pointing at a complete state directory:
+
+        save state_N | replace sidecar -> N | prune state_{N-every}
+        ^ kill: sidecar -> N-every, intact    ^ kill: both dirs exist
+    """
+    import numpy as np
+
+    os.makedirs(directory, exist_ok=True)
+    hist_path = os.path.join(directory, "history.npz")
+
+    state = topo = hist = None
+    done, wall = 0, 0.0
+    if resume:
+        if not os.path.exists(hist_path):
+            raise ValueError(
+                f"resume requested but {directory!r} holds no checkpoint "
+                "(no history.npz) — refusing to silently start over")
+        with np.load(hist_path) as m:
+            done = int(m["rounds_done"])
+            if done > rounds:
+                raise ValueError(
+                    f"checkpoint already contains {done} rounds > the "
+                    f"requested {rounds} — re-run with rounds >= {done}")
+            hist = {k: m[k] for k in m.files
+                    if k not in ("rounds_done", "wall_s")}
+            wall = float(m["wall_s"])
+        target = {"state": sim.init_state(), "topo": sim.topo}
+        restored = restore(os.path.join(directory, f"state_{done}"),
+                           target)
+        state, topo = restored["state"], restored["topo"]
+
+    def persist(state, topo, hist, wall, done):
+        save(os.path.join(directory, f"state_{done}"),
+             {"state": state, "topo": topo})
+        tmp = hist_path + ".tmp.npz"
+        np.savez(tmp, rounds_done=done, wall_s=wall, **hist)
+        os.replace(tmp, hist_path)
+        for name in os.listdir(directory):
+            if name.startswith("state_") and name != f"state_{done}":
+                import shutil
+
+                shutil.rmtree(os.path.join(directory, name),
+                              ignore_errors=True)
+
+    result, *_ = run_chunked(sim, rounds, every=every, state=state,
+                             topo=topo, hist=hist, wall=wall, done=done,
+                             after_chunk=persist)
+    return result

@@ -100,38 +100,24 @@ class Peer:
         # partial chunk (rounds % JAX_ROUND_CHUNK) compiles once more,
         # and that compile time lands in the summed wall_s.
         def _run():
-            import dataclasses
-            import inspect
+            # The shared chunk driver (utils.checkpoint.run_chunked —
+            # also the engine under --checkpoint-every) with the stop
+            # flag checked between chunks; result-type agnostic, so
+            # every engine x mode the config can name rides this one
+            # loop.
+            from p2p_gossipprotocol_tpu.utils.checkpoint import \
+                run_chunked
 
-            import numpy as np
+            def progress(state, topo, hist, wall, done):
+                self.rounds_completed = done
 
-            # Result-type agnostic (SimResult and SIRResult both carry
-            # state/topo/wall_s plus per-round history arrays), so every
-            # engine x mode the config can name runs through this one
-            # chunked loop.
-            takes_topo = "topo" in inspect.signature(
-                self._sim.run).parameters
-            state, topo, hist, wall, done = None, None, None, 0.0, 0
-            result_cls = None
             try:
-                while done < rounds and not self._stop_event.is_set():
-                    step = min(self.JAX_ROUND_CHUNK, rounds - done)
-                    kw = {"topo": topo} if takes_topo else {}
-                    r = self._sim.run(step, state=state, **kw)
-                    result_cls = type(r)
-                    state, topo = r.state, r.topo
-                    part = {f.name: getattr(r, f.name)
-                            for f in dataclasses.fields(r)
-                            if f.name not in ("state", "topo", "wall_s")}
-                    hist = part if hist is None else {
-                        k: np.concatenate([hist[k], part[k]])
-                        for k in part}
-                    wall += r.wall_s
-                    done += step
-                    self.rounds_completed = done
-                if result_cls is not None:
-                    self._result = result_cls(state=state, topo=topo,
-                                              wall_s=wall, **hist)
+                result, *_ = run_chunked(
+                    self._sim, rounds, every=self.JAX_ROUND_CHUNK,
+                    after_chunk=progress,
+                    should_stop=self._stop_event.is_set)
+                if result is not None:
+                    self._result = result
             except Exception as e:  # noqa: BLE001 — surface via join()
                 # Without this, a mid-chunk failure (trace error, OOM)
                 # would leave is_running() True forever and join() would

@@ -196,3 +196,38 @@ def test_run_with_checkpoints_2d_mesh(tmp_path, devices8):
     np.testing.assert_array_equal(resumed.coverage, full.coverage)
     np.testing.assert_array_equal(np.asarray(resumed.state.seen_w),
                                   np.asarray(full.state.seen_w))
+
+
+def test_checkpoint_layout_is_crash_safe(tmp_path):
+    """Review contract: each chunk lands in a fresh state_<round> dir,
+    the sidecar is atomically replaced AFTER the state, and stale dirs
+    are pruned — so a kill at any instant leaves the sidecar pointing
+    at a complete state.  Also: resume without a checkpoint is a hard
+    error, and resuming with fewer rounds than checkpointed refuses."""
+    import os
+
+    import pytest
+
+    topo = build_aligned(seed=2, n=1024, n_slots=6)
+
+    def mk():
+        return AlignedSimulator(topo=topo, n_msgs=8, mode="push", seed=3)
+
+    d = str(tmp_path / "ck")
+    with pytest.raises(ValueError, match="no checkpoint"):
+        checkpoint.run_with_checkpoints(mk(), 8, every=4, directory=d,
+                                        resume=True)
+
+    checkpoint.run_with_checkpoints(mk(), 8, every=4, directory=d)
+    entries = sorted(os.listdir(d))
+    assert entries == ["history.npz", "state_8"]   # stale state_4 pruned
+
+    with pytest.raises(ValueError, match="re-run with rounds >= 8"):
+        checkpoint.run_with_checkpoints(mk(), 4, every=4, directory=d,
+                                        resume=True)
+
+    # resume exactly at the stored round count: nothing re-runs, the
+    # stored history comes back whole
+    res = checkpoint.run_with_checkpoints(mk(), 8, every=4, directory=d,
+                                          resume=True)
+    assert len(res.coverage) == 8
