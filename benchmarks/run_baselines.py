@@ -178,11 +178,13 @@ def bench_sharded_byz() -> dict:
     n_dev = len(jax.devices())
     rows = int(os.environ.get("GOSSIP_BASELINE_SHARD_ROWS", str(1 << 20)))
     topo = build_aligned(seed=0, n=rows, n_slots=8,
-                         degree_law="powerlaw", n_shards=n_dev)
+                         degree_law="powerlaw", n_shards=n_dev,
+                         roll_groups=4)
     sim = AlignedShardedSimulator(
         topo=topo, mesh=make_mesh(n_dev), n_msgs=4, mode="pushpull",
         churn=ChurnConfig(rate=0.05, kill_round=1),
-        byzantine_fraction=0.1, n_honest_msgs=3, max_strikes=3, seed=0)
+        byzantine_fraction=0.1, n_honest_msgs=3, max_strikes=3,
+        liveness_every=3, seed=0)
     rounds = 24
     res = sim.run(rounds, warmup=True)
     final_cov = float(res.coverage[-1])
@@ -206,7 +208,8 @@ def bench_sir1m_aligned() -> dict:
     from p2p_gossipprotocol_tpu.aligned_sir import AlignedSIRSimulator
 
     n = int(os.environ.get("GOSSIP_BASELINE_SIR_PEERS", str(1 << 20)))
-    topo = build_aligned(seed=0, n=n, n_slots=8, degree_law="powerlaw")
+    topo = build_aligned(seed=0, n=n, n_slots=8, degree_law="powerlaw",
+                         roll_groups=4)
     sim = AlignedSIRSimulator(topo=topo, beta=0.3, gamma=0.1, n_seeds=10,
                               seed=0)
     res = sim.run(128, warmup=True)
