@@ -314,3 +314,38 @@ def test_cli_2d_mesh_engine(tmp_path):
                           env=env, cwd=str(REPO_ROOT))
     assert proc.returncode == 1
     assert "--msg-shards needs" in proc.stderr
+
+
+def test_cli_checkpoint_resume_sharded(tmp_path):
+    """--checkpoint-every composed with --mesh-devices: the orbax
+    checkpoint carries mesh-sharded device arrays, and the resumed
+    sharded run prints the uninterrupted summary."""
+    env = {"PYTHONPATH": str(REPO_ROOT), "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+           "PATH": "/usr/bin:/bin:/usr/local/bin"}
+    cfg = tmp_path / "net.txt"
+    cfg.write_text("10.0.0.1:8000\nbackend=jax\nengine=aligned\n"
+                   "graph=er\nn_peers=2048\navg_degree=6\n"
+                   "mode=pushpull\nn_messages=32\nchurn_rate=0.05\n")
+    base = [sys.executable, "-m", "p2p_gossipprotocol_tpu.cli",
+            str(cfg), "--mesh-devices", "8", "--quiet"]
+    ck = ["--checkpoint-dir", str(tmp_path / "ck")]
+
+    def summary(proc):
+        assert proc.returncode == 0, proc.stderr
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        out.pop("wall_s"), out.pop("msgs_per_sec")
+        return out
+
+    full = summary(subprocess.run(base + ["--rounds", "8"],
+                                  capture_output=True, text=True,
+                                  timeout=600, env=env,
+                                  cwd=str(REPO_ROOT)))
+    subprocess.run(base + ["--rounds", "4", "--checkpoint-every", "4"]
+                   + ck, capture_output=True, text=True, timeout=600,
+                   env=env, cwd=str(REPO_ROOT))
+    resumed = summary(subprocess.run(
+        base + ["--rounds", "8", "--checkpoint-every", "4", "--resume"]
+        + ck, capture_output=True, text=True, timeout=600, env=env,
+        cwd=str(REPO_ROOT)))
+    assert resumed == full
