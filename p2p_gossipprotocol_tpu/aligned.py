@@ -588,15 +588,7 @@ class AlignedSimulator:
         (state, topo), ys = fn(state, topo)
         int(jax.device_get(state.round))  # forces completion
         wall = _time.perf_counter() - t0
-        return SimResult(
-            state=state, topo=topo,
-            coverage=np.asarray(ys["coverage"]),
-            deliveries=np.asarray(ys["deliveries"]),
-            frontier_size=np.asarray(ys["frontier_size"]),
-            live_peers=np.asarray(ys["live_peers"]),
-            evictions=np.asarray(ys["evictions"]),
-            wall_s=wall,
-        )
+        return SimResult.from_metrics(state, topo, ys, wall)
 
     def run_to_coverage(self, target: float = 0.99, max_rounds: int = 256,
                         state: AlignedState | None = None,

@@ -170,15 +170,7 @@ class AlignedSIRSimulator:
         state, ys = self._scan_cache[rounds](state)
         int(jax.device_get(state.round))   # forces completion
         wall = _time.perf_counter() - t0
-        return SIRResult(
-            state=state, topo=self.topo,
-            susceptible=np.asarray(ys["susceptible"]),
-            infected=np.asarray(ys["infected"]),
-            recovered=np.asarray(ys["recovered"]),
-            new_infections=np.asarray(ys["new_infections"]),
-            live_peers=np.asarray(ys["live_peers"]),
-            wall_s=wall,
-        )
+        return SIRResult.from_metrics(state, self.topo, ys, wall)
 
 
 def aligned_sir_round(sim: AlignedSIRSimulator, state: AlignedSIRState,

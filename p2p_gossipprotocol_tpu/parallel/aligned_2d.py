@@ -40,6 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator, AlignedState,
                                             AlignedTopology, aligned_round)
 from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+from p2p_gossipprotocol_tpu.parallel.aligned_sharded import _topo_spec
 from p2p_gossipprotocol_tpu.parallel.mesh import PEER_AXIS
 
 MSG_AXIS = "msgs"
@@ -54,13 +55,6 @@ def make_mesh_2d(n_msg_shards: int, n_peer_shards: int,
         raise ValueError(f"need {need} devices, have {len(devices)}")
     grid = np.asarray(devices[:need]).reshape(n_msg_shards, n_peer_shards)
     return Mesh(grid, (MSG_AXIS, PEER_AXIS))
-
-
-def _topo_spec(topo: AlignedTopology) -> AlignedTopology:
-    return topo.replace(
-        perm=P(), rolls=P(), subrolls=P(),
-        colidx=P(None, PEER_AXIS, None), deg=P(PEER_AXIS, None),
-        valid_w=P(PEER_AXIS, None))
 
 
 def _state_spec(liveness: bool) -> AlignedState:
@@ -201,12 +195,4 @@ class Aligned2DShardedSimulator:
         (state, topo), ys = fn(state, topo)
         int(jax.device_get(state.round))
         wall = _time.perf_counter() - t0
-        return SimResult(
-            state=state, topo=topo,
-            coverage=np.asarray(ys["coverage"]),
-            deliveries=np.asarray(ys["deliveries"]),
-            frontier_size=np.asarray(ys["frontier_size"]),
-            live_peers=np.asarray(ys["live_peers"]),
-            evictions=np.asarray(ys["evictions"]),
-            wall_s=wall,
-        )
+        return SimResult.from_metrics(state, topo, ys, wall)

@@ -200,15 +200,7 @@ class AlignedShardedSimulator:
         (state, topo), ys = fn(state, topo)
         int(jax.device_get(state.round))    # forces completion
         wall = _time.perf_counter() - t0
-        return SimResult(
-            state=state, topo=topo,
-            coverage=np.asarray(ys["coverage"]),
-            deliveries=np.asarray(ys["deliveries"]),
-            frontier_size=np.asarray(ys["frontier_size"]),
-            live_peers=np.asarray(ys["live_peers"]),
-            evictions=np.asarray(ys["evictions"]),
-            wall_s=wall,
-        )
+        return SimResult.from_metrics(state, topo, ys, wall)
 
     def run_to_coverage(self, target: float = 0.99, max_rounds: int = 256,
                         state: AlignedState | None = None,
@@ -365,12 +357,4 @@ class AlignedShardedSIRSimulator:
         state, ys = self._scan_cache[rounds](state, topo)
         int(jax.device_get(state.round))
         wall = _time.perf_counter() - t0
-        return SIRResult(
-            state=state, topo=self.topo,
-            susceptible=np.asarray(ys["susceptible"]),
-            infected=np.asarray(ys["infected"]),
-            recovered=np.asarray(ys["recovered"]),
-            new_infections=np.asarray(ys["new_infections"]),
-            live_peers=np.asarray(ys["live_peers"]),
-            wall_s=wall,
-        )
+        return SIRResult.from_metrics(state, self.topo, ys, wall)

@@ -310,15 +310,7 @@ class ShardedSimulator:
         (state, stopo), ys = fn(state, stopo)
         jax.block_until_ready(state.seen)
         wall = _time.perf_counter() - t0
-        return SimResult(
-            state=state, topo=stopo,
-            coverage=np.asarray(ys["coverage"]),
-            deliveries=np.asarray(ys["deliveries"]),
-            frontier_size=np.asarray(ys["frontier_size"]),
-            live_peers=np.asarray(ys["live_peers"]),
-            evictions=np.asarray(ys["evictions"]),
-            wall_s=wall,
-        )
+        return SimResult.from_metrics(state, stopo, ys, wall)
 
     def run_to_coverage(self, target: float = 0.99, max_rounds: int = 256,
                         state: GossipState | None = None,

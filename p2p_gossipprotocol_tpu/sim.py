@@ -45,8 +45,23 @@ def coverage_of(state: GossipState, n_honest: int | None = None
     return jnp.mean(per_msg)
 
 
+class _FromMetrics:
+    """Shared assembly from a scan's stacked metrics dict — every
+    engine's ``run()`` ends with ``Result.from_metrics(...)``, so the
+    result surface is defined in exactly one place per class."""
+
+    @classmethod
+    def from_metrics(cls, state, topo, ys: dict, wall_s: float):
+        import dataclasses
+
+        names = [f.name for f in dataclasses.fields(cls)
+                 if f.name not in ("state", "topo", "wall_s")]
+        return cls(state=state, topo=topo, wall_s=wall_s,
+                   **{k: np.asarray(ys[k]) for k in names})
+
+
 @dataclass
-class SimResult:
+class SimResult(_FromMetrics):
     """Host-side results of a run."""
 
     state: GossipState
@@ -155,15 +170,7 @@ class Simulator:
         (state, topo), ys = self._scan_jit(state, topo, rounds)
         jax.block_until_ready(state.seen)
         wall = _time.perf_counter() - t0
-        return SimResult(
-            state=state, topo=topo,
-            coverage=np.asarray(ys["coverage"]),
-            deliveries=np.asarray(ys["deliveries"]),
-            frontier_size=np.asarray(ys["frontier_size"]),
-            live_peers=np.asarray(ys["live_peers"]),
-            evictions=np.asarray(ys["evictions"]),
-            wall_s=wall,
-        )
+        return SimResult.from_metrics(state, topo, ys, wall)
 
     # ------------------------------------------------------------------
     def run_to_coverage(self, target: float = 0.99, max_rounds: int = 256,
@@ -238,7 +245,7 @@ class Simulator:
 
 
 @dataclass
-class SIRResult:
+class SIRResult(_FromMetrics):
     """Host-side epidemic curve (the per-round S/I/R census)."""
 
     state: SIRState
@@ -329,15 +336,7 @@ class SIRSimulator:
         state, ys = self._scan_jit(state, rounds)
         jax.block_until_ready(state.compartment)
         wall = _time.perf_counter() - t0
-        return SIRResult(
-            state=state, topo=self.topo,
-            susceptible=np.asarray(ys["susceptible"]),
-            infected=np.asarray(ys["infected"]),
-            recovered=np.asarray(ys["recovered"]),
-            new_infections=np.asarray(ys["new_infections"]),
-            live_peers=np.asarray(ys["live_peers"]),
-            wall_s=wall,
-        )
+        return SIRResult.from_metrics(state, self.topo, ys, wall)
 
     # ------------------------------------------------------------------
     @classmethod

@@ -138,6 +138,8 @@ def run_with_checkpoints(sim, rounds: int, *, every: int, directory: str,
         state, topo = restored["state"], restored["topo"]
 
     def persist(state, topo, hist, wall, done):
+        import shutil
+
         save(os.path.join(directory, f"state_{done}"),
              {"state": state, "topo": topo})
         tmp = hist_path + ".tmp.npz"
@@ -145,8 +147,6 @@ def run_with_checkpoints(sim, rounds: int, *, every: int, directory: str,
         os.replace(tmp, hist_path)
         for name in os.listdir(directory):
             if name.startswith("state_") and name != f"state_{done}":
-                import shutil
-
                 shutil.rmtree(os.path.join(directory, name),
                               ignore_errors=True)
 
