@@ -222,3 +222,28 @@ def test_roll_groups_key(tmp_path):
     assert NetworkConfig(str(cfg)).roll_groups == 4
     cfg.write_text("10.0.0.1:8000\n")
     assert NetworkConfig(str(cfg)).roll_groups == 0
+
+
+def test_config_parser_never_crashes_on_junk(tmp_path):
+    """Seeded fuzz: any byte soup must either parse or raise ConfigError
+    with a line number — never an unhandled exception (the reference
+    atoi-crashes on non-numeric values, SURVEY §2-C3)."""
+    import random
+
+    from p2p_gossipprotocol_tpu.config import ConfigError, NetworkConfig
+
+    rng = random.Random(0)
+    tokens = ["10.0.0.1:8000", "=", ":", "#", "n_peers", "mode", "push",
+              "999999999999999999999", "-1", "1e9", "::", "a.b.c.d:x",
+              "backend", "jax", "\x00", "🦜", " ", "\t", "engine"]
+    cfg = tmp_path / "net.txt"
+    for i in range(200):
+        lines = ["10.0.0.1:8000"] if rng.random() < 0.5 else []
+        for _ in range(rng.randrange(6)):
+            lines.append("".join(rng.choice(tokens)
+                                 for _ in range(rng.randrange(1, 5))))
+        cfg.write_text("\n".join(lines), errors="replace")
+        try:
+            NetworkConfig(str(cfg))
+        except ConfigError:
+            pass
