@@ -313,6 +313,9 @@ class PeerNode:
                 if objs is None:
                     break
                 for msg in objs:
+                    if not isinstance(msg, dict):
+                        continue   # `42` / `"x"` are valid JSON docs; a
+                        # .get() on them would kill this reader thread
                     if msg.get("type") == "gossip":
                         self._on_gossip(Message.from_wire(msg), conn)
                     elif msg.get("type") == "pull_request":

@@ -95,7 +95,9 @@ class SeedNode:
                 if objs is None:
                     break
                 for req in objs:
-                    self._dispatch(conn, req)
+                    if isinstance(req, dict):   # `42` is a valid JSON
+                        self._dispatch(conn, req)  # doc; .get() would
+                        # kill this handler thread
         finally:
             try:
                 conn.close()

@@ -120,7 +120,18 @@ class FramedStream:
                 pass
             return None
         self._buf = self._buf[consumed:]
-        return [json.loads(f) for f in frames]
+        try:
+            return [json.loads(f) for f in frames]
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            # A well-framed payload that isn't JSON: the sender is
+            # corrupt or hostile; drop the connection like the
+            # over-length case (letting it escape would kill the reader
+            # thread with a traceback instead).
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            return None
 
 
 WIRE_FORMATS = {

@@ -104,3 +104,65 @@ def test_default_transport_is_jax():
                          transport=JaxTransport())
     ra, rb = sim.run(4), explicit.run(4)
     assert (np.asarray(ra.state.seen) == np.asarray(rb.state.seen)).all()
+
+
+def test_streams_never_crash_on_junk_bytes():
+    """Seeded fuzz of both receive paths: arbitrary byte chunks (random
+    splits, embedded valid docs, bogus lengths) must yield docs, [], or
+    None (EOF/drop) — never an unhandled exception.  The reference
+    crashes its parser on a split document (peer.cpp:188-194)."""
+    import json
+    import random
+    import socket
+
+    from p2p_gossipprotocol_tpu.transport.socket_transport import (
+        FramedStream, JsonStream)
+
+    rng = random.Random(1)
+    valid = json.dumps({"type": "gossip", "content": "x" * 10}).encode()
+    for stream_cls in (JsonStream, FramedStream):
+        for i in range(100):
+            blobs = []
+            for _ in range(rng.randrange(1, 5)):
+                pick = rng.random()
+                if pick < 0.4:
+                    blobs.append(valid)
+                elif pick < 0.7:
+                    blobs.append(bytes(rng.randrange(256)
+                                       for _ in range(rng.randrange(40))))
+                else:
+                    blobs.append(rng.randbytes(4))   # bogus length prefix
+            data = b"".join(blobs)
+            a, b = socket.socketpair()
+            try:
+                stream = stream_cls(b)
+                pos = 0
+                while pos < len(data):
+                    step = rng.randrange(1, 32)
+                    a.sendall(data[pos:pos + step])
+                    pos += step
+                    out = stream.recv_objects()
+                    assert out is None or isinstance(out, list)
+                    if out is None:
+                        break           # stream dropped the connection
+            finally:
+                a.close()
+                b.close()
+
+
+def test_framed_non_json_payload_drops_connection():
+    """A well-formed frame whose payload isn't JSON = corrupt/hostile
+    sender: the stream must surface EOF (drop), not raise."""
+    import socket
+
+    from p2p_gossipprotocol_tpu import native
+    from p2p_gossipprotocol_tpu.transport.socket_transport import \
+        FramedStream
+
+    a, b = socket.socketpair()
+    try:
+        a.sendall(native.frame_encode(b"not json at all"))
+        assert FramedStream(b).recv_objects() is None
+    finally:
+        a.close()
+        b.close()
