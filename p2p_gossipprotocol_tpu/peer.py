@@ -316,10 +316,16 @@ class PeerNode:
                     if not isinstance(msg, dict):
                         continue   # `42` / `"x"` are valid JSON docs; a
                         # .get() on them would kill this reader thread
-                    if msg.get("type") == "gossip":
-                        self._on_gossip(Message.from_wire(msg), conn)
-                    elif msg.get("type") == "pull_request":
-                        self._serve_pull(conn, set(msg.get("have", ())))
+                    try:
+                        if msg.get("type") == "gossip":
+                            self._on_gossip(Message.from_wire(msg), conn)
+                        elif msg.get("type") == "pull_request":
+                            self._serve_pull(conn,
+                                             set(msg.get("have", ())))
+                    except (KeyError, ValueError, TypeError):
+                        continue   # malformed document (missing fields,
+                        # non-int port, non-iterable digest): skip it,
+                        # don't let a corrupt peer kill the reader
         except OSError:
             pass
         finally:

@@ -95,9 +95,13 @@ class SeedNode:
                 if objs is None:
                     break
                 for req in objs:
-                    if isinstance(req, dict):   # `42` is a valid JSON
-                        self._dispatch(conn, req)  # doc; .get() would
-                        # kill this handler thread
+                    if not isinstance(req, dict):
+                        continue   # `42` is a valid JSON doc; .get()
+                        # would kill this handler thread
+                    try:
+                        self._dispatch(conn, req)
+                    except (KeyError, ValueError, TypeError):
+                        continue   # malformed request: skip, stay up
         finally:
             try:
                 conn.close()

@@ -132,3 +132,36 @@ def test_scripted_demo_framed_wire(tmp_path):
         env={**os.environ, "PYTHONPATH": str(repo)}, cwd=str(tmp_path))
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "SUCCESS" in proc.stdout
+
+
+@pytest.mark.parametrize("doc", [
+    b'{"type":"gossip","content":"x"}',            # missing fields
+    b'{"type":"gossip","content":"x","timestamp":"1",'
+    b'"source_ip":"a","source_port":"nope","msg_number":0}',
+    b'{"type":"pull_request","have":42}',          # non-iterable digest
+    b'42',                                         # non-dict doc
+])
+def test_malformed_documents_do_not_kill_the_reader(tmp_path, doc):
+    """A corrupt or hostile peer sending structurally-broken documents
+    must not kill the reader thread: the node keeps serving valid
+    gossip on the same connection afterwards."""
+    import json as _json
+
+    node = PeerNode("127.0.0.1", _free_port(), [], log_dir=str(tmp_path))
+    node.running = True
+    node.transport.start()
+    t = __import__("threading").Thread(target=node._accept_loop,
+                                       daemon=True)
+    t.start()
+    try:
+        sock = socket.create_connection(("127.0.0.1", node.port))
+        sock.sendall(doc)
+        good = {"type": "gossip", "content": "ok", "timestamp": "7",
+                "source_ip": "127.0.0.1", "source_port": 1, "msg_number": 0}
+        sock.sendall(_json.dumps(good).encode())
+        assert _wait(lambda: len(node.message_list) == 1, timeout=5.0), \
+            "reader died on the malformed document"
+        sock.close()
+    finally:
+        node.running = False
+        node.transport.stop()
