@@ -652,3 +652,25 @@ def test_roll_groups_layout():
     assert len(np.unique(rolls[4:8])) == 1
     groups = {tuple(rolls[i:i + 4]) for i in range(0, 16, 4)}
     assert len(groups) >= 2          # t_blocks large enough to differ
+
+
+def test_hbm_traffic_model_counts_streams():
+    """The traffic model behind the bench's achieved_gb_s: scales with
+    message planes, counts only distinct consecutive block rolls, and
+    amortizes the liveness pass by its stride."""
+    from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+
+    topo = build_aligned(seed=1, n=65536, n_slots=16, rowblk=64)
+    topo_g = build_aligned(seed=1, n=65536, n_slots=16, rowblk=64,
+                           roll_groups=4)
+
+    def bytes_for(t, **kw):
+        return AlignedSimulator(topo=t, mode="pushpull", seed=0,
+                                **kw).hbm_bytes_per_round()
+
+    assert bytes_for(topo_g, n_msgs=32) < bytes_for(topo, n_msgs=32)
+    assert bytes_for(topo, n_msgs=64) > bytes_for(topo, n_msgs=32)
+    churned = dict(churn=ChurnConfig(rate=0.05), n_msgs=32)
+    every1 = bytes_for(topo, **churned)
+    every3 = bytes_for(topo, liveness_every=3, **churned)
+    assert bytes_for(topo, n_msgs=32) < every3 < every1
