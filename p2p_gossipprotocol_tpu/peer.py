@@ -141,7 +141,11 @@ class PeerNode:
 
         Unlike the reference (whose ``start`` never returns while running —
         it becomes the accept loop, peer.cpp:87-101), this returns after
-        bootstrap; the accept loop runs on a thread.
+        bootstrap; the accept loop runs on a thread.  Returns False when
+        the ``n/2+1`` seed quorum was not reached by the deadline (the
+        reference BLOCKS forever on that, peer.cpp:64-78); the node stays
+        up and keeps retrying the seeds in the background with backoff
+        until quorum or stop().
         """
         self.transport.start()
         self.running = True
