@@ -196,3 +196,48 @@ class Aligned2DShardedSimulator:
         int(jax.device_get(state.round))
         wall = _time.perf_counter() - t0
         return SimResult.from_metrics(state, topo, ys, wall)
+
+    def run_to_coverage(self, target: float = 0.99, max_rounds: int = 256,
+                        state: AlignedState | None = None,
+                        topo: AlignedTopology | None = None,
+                        warmup: bool = True):
+        """(state, topo, rounds_run, wall_s) — the benchmark path, same
+        contract as the 1-D sharded engine (compile + first-execution
+        upload excluded, completion forced by a scalar device_get)."""
+        import time as _time
+
+        state = self.init_state() if state is None else state
+        topo = self.shard_topo(topo)
+        cache_key = ("cov", target, max_rounds)
+        if cache_key not in self._run_cache:
+            st_spec = _state_spec(self._liveness)
+            tp_spec = _topo_spec(self.topo)
+
+            def looped(st, tp):
+                def cond(carry):
+                    st, tp, cov = carry
+                    return (cov < target) & (st.round < max_rounds)
+
+                def body(carry):
+                    st, tp, _ = carry
+                    st, tp, metrics = self._step_local(st, tp)
+                    return st, tp, metrics["coverage"]
+
+                return jax.lax.while_loop(cond, body,
+                                          (st, tp, jnp.float32(0)))
+
+            fn = jax.jit(jax.shard_map(
+                looped, mesh=self.mesh,
+                in_specs=(st_spec, tp_spec),
+                out_specs=(st_spec, tp_spec, P()),
+                check_vma=False))
+            self._run_cache[cache_key] = fn.lower(state, topo).compile()
+        fn_c = self._run_cache[cache_key]
+        if warmup:
+            out = fn_c(state, topo)
+            jax.device_get(out[0].round)
+        t0 = _time.perf_counter()
+        st, tp, cov = fn_c(state, topo)
+        rounds_run = int(jax.device_get(st.round))
+        wall = _time.perf_counter() - t0
+        return st, tp, rounds_run, wall

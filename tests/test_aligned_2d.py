@@ -55,3 +55,17 @@ def test_2d_plane_placement(devices8):
     shard = st.seen_w.addressable_shards[0]
     W, R = st.seen_w.shape[0], st.seen_w.shape[1]
     assert shard.data.shape == (W // 2, R // 4, 128)
+
+
+def test_2d_run_to_coverage(devices8):
+    """The benchmark path on the 2-D mesh: same 4-tuple contract and
+    round count as the unsharded engine on the same scenario."""
+    topo = build_aligned(seed=9, n=2048, n_slots=6, rowblk=1, n_shards=4)
+    kw = dict(topo=topo, n_msgs=64, mode="pushpull", seed=3)
+    su = AlignedSimulator(**kw)
+    stu, tpu_, ru, _ = su.run_to_coverage(0.99, max_rounds=64)
+    s2 = Aligned2DShardedSimulator(mesh=make_mesh_2d(2, 4), **kw)
+    st2, tp2, r2, _ = s2.run_to_coverage(0.99, max_rounds=64)
+    assert r2 == ru
+    np.testing.assert_array_equal(np.asarray(st2.seen_w),
+                                  np.asarray(stu.seen_w))
