@@ -48,7 +48,12 @@ MSG_AXIS = "msgs"
 
 def make_mesh_2d(n_msg_shards: int, n_peer_shards: int,
                  devices=None) -> Mesh:
-    """(msgs, peers) mesh over the first n_msg*n_peer devices."""
+    """(msgs, peers) mesh over the first n_msg*n_peer devices.
+
+    The peer axis is the MINOR (fastest-varying) axis of the device
+    grid on purpose: it carries the per-round all_gather of the send
+    words, so adjacent peer shards should sit on adjacent chips (ICI
+    neighbors on a real pod); the msg axis moves only scalar psums."""
     devices = jax.devices() if devices is None else devices
     need = n_msg_shards * n_peer_shards
     if len(devices) < need:
