@@ -1,0 +1,132 @@
+"""Round-4 TPU measurements: liveness-stride / roll-group A-B at 1M, the
+10M x 256-message headline, 10M x 32 comparison, 10M SIR, and a
+profiler trace.
+
+Run on the chip (the axon plugin needs its site dir on PYTHONPATH):
+    PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/measure_round4.py
+Appends one JSON row per config to GOSSIP_R4_OUT (default
+benchmarks/results/round4_tpu.jsonl).  The tunnel is flaky: probe the
+backend first (see bench.py:_init_backend) and retry.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+OUT = os.environ.get(
+    "GOSSIP_R4_OUT",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "results", "round4_tpu.jsonl"))
+
+
+def emit(row):
+    row["device"] = str(jax.devices()[0]).replace(" ", "_")
+    row["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    print(json.dumps(row), flush=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def main():
+    from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator,
+                                                aligned_coverage,
+                                                build_aligned)
+    from p2p_gossipprotocol_tpu.aligned_sir import AlignedSIRSimulator
+    from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+
+    # --- 1) liveness stride x roll groups at 1M x 32 msgs -----------------
+    for groups in (None, 4):
+        topo1m = build_aligned(seed=7, n=1 << 20, n_slots=16,
+                               degree_law="powerlaw", roll_groups=groups)
+        for every in (1, 3):
+            sim = AlignedSimulator(
+                topo=topo1m, n_msgs=32, mode="pushpull",
+                churn=ChurnConfig(rate=0.05, kill_round=1),
+                max_strikes=3, liveness_every=every, seed=1)
+            res = sim.run(12, warmup=True)
+            emit({"config": (f"1m_32msg_liveness_every_{every}"
+                             f"_groups_{groups}"),
+                  "n_peers": 1 << 20, "n_msgs": 32,
+                  "wall_s": round(res.wall_s, 4),
+                  "ms_per_round": round(res.wall_s / 12 * 1000, 3),
+                  "final_coverage": round(float(res.coverage[-1]), 4),
+                  "evictions": int(res.evictions.sum()),
+                  "bytes_per_round": sim.hbm_bytes_per_round(),
+                  "achieved_gb_s": round(
+                      sim.hbm_bytes_per_round() * 12 / res.wall_s / 1e9,
+                      1)})
+        del topo1m
+
+    # --- 2) the 1M north-star config through bench's own path ------------
+    os.environ.setdefault("GOSSIP_BENCH_LIVENESS_EVERY", "3")
+    import bench as bench_mod
+    (rounds, wall, total_seen, n_edges, graph_s,
+     extras) = bench_mod._bench_aligned(1 << 20, 16, 16, "pushpull")
+    emit({"config": "pl1m_churn_r4", "n_peers": 1 << 20, "n_msgs": 16,
+          "rounds": rounds, "wall_s": round(wall, 4),
+          "graph_build_s": round(graph_s, 2), **extras})
+
+    # --- 3) 10M x 32 and the 256-message headline -------------------------
+    for n_msgs in (32, 256):
+        t0 = time.perf_counter()
+        topo = build_aligned(seed=0, n=10_000_000, n_slots=16,
+                             degree_law="powerlaw", n_msgs=n_msgs,
+                             roll_groups=4)
+        graph_s = time.perf_counter() - t0
+        sim = AlignedSimulator(topo=topo, n_msgs=n_msgs, mode="pushpull",
+                               churn=ChurnConfig(rate=0.05, kill_round=1),
+                               max_strikes=3, liveness_every=3, seed=0)
+        state, topo2, rounds, wall = sim.run_to_coverage(
+            target=0.99, max_rounds=128)
+        cov = aligned_coverage(sim, state, topo2)
+        assert cov >= 0.99, cov
+        emit({"config": f"10m_{n_msgs}msg_churn", "n_peers": 10_000_000,
+              "n_msgs": n_msgs, "rounds": rounds,
+              "wall_s": round(wall, 4),
+              "ms_per_round": round(wall / max(rounds, 1) * 1000, 2),
+              "final_coverage": round(cov, 5),
+              "graph_build_s": round(graph_s, 2),
+              "bytes_per_round": sim.hbm_bytes_per_round(),
+              "achieved_gb_s": round(
+                  sim.hbm_bytes_per_round() * rounds / wall / 1e9, 1)})
+
+        if n_msgs == 32:
+            # profiler trace of a steady-state run (compiled already);
+            # best-effort — tracing a tunneled PJRT backend can fail and
+            # must not sink the measurements
+            trace_dir = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "profiles", "r4_10m")
+            try:
+                os.makedirs(trace_dir, exist_ok=True)
+                with jax.profiler.trace(trace_dir):
+                    sim.run(8)
+                emit({"config": "10m_32msg_profile",
+                      "trace_dir": trace_dir})
+            except Exception as e:  # noqa: BLE001
+                emit({"config": "10m_32msg_profile",
+                      "error": f"{type(e).__name__}: {e}"})
+        del topo, sim, state, topo2
+
+    # --- 4) SIR at 10M on the scale engine --------------------------------
+    topo = build_aligned(seed=0, n=10_000_000, n_slots=8,
+                         degree_law="powerlaw")
+    sim = AlignedSIRSimulator(topo=topo, beta=0.3, gamma=0.1, n_seeds=10,
+                              seed=0)
+    res = sim.run(128, warmup=True)
+    emit({"config": "sir10m_aligned", "n_peers": 10_000_000,
+          "rounds": 128, "wall_s": round(res.wall_s, 4),
+          "ms_per_round": round(res.wall_s / 128 * 1000, 2),
+          "peak_infected": res.peak_infected,
+          "attack_rate": round(res.attack_rate, 4),
+          "extinct_at": res.rounds_to_extinction()})
+
+
+if __name__ == "__main__":
+    main()
