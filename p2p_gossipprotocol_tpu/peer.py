@@ -251,6 +251,11 @@ class PeerNode:
             return True
         except _SEND_ERRORS():
             return False
+        except (KeyError, ValueError, TypeError, AttributeError):
+            # Malformed reply (non-dict doc, bogus peers list, non-int
+            # port): a corrupt seed counts as a failed seed, it must not
+            # crash bootstrap.
+            return False
         finally:
             try:
                 sock.close()

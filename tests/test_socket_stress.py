@@ -317,3 +317,39 @@ def test_ping_cadence_matches_interval(tmp_path):
     # exact 0.4 s cadence → 5 sweeps in 2.2 s; the drifting pacing
     # (~1.4 s/sweep) would manage at most 2
     assert len(sweeps) >= 4, f"only {len(sweeps)} sweeps in 2.2 s"
+
+
+@pytest.mark.parametrize("reply", [
+    b'{"type":"peer_list","peers":[{"nope":1}]}',
+    b'{"type":"peer_list","peers":42}',
+    b'{"type":"peer_list","peers":[{"ip":"a","port":"x"}]}',
+    b'"junk"',
+])
+def test_corrupt_seed_reply_does_not_crash_bootstrap(tmp_path, reply):
+    """A hostile/corrupt seed answering register with a malformed
+    peer_list must count as a failed seed, not crash start()."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def fake_seed():
+        conn, _ = srv.accept()
+        conn.recv(4096)            # the register document
+        conn.sendall(reply)
+        conn.close()
+
+    t = threading.Thread(target=fake_seed, daemon=True)
+    t.start()
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    my_port = probe.getsockname()[1]
+    probe.close()
+    node = PeerNode("127.0.0.1", my_port, [PeerInfo("127.0.0.1", port)],
+                    log_dir=str(tmp_path))
+    try:
+        assert node.start(bootstrap_timeout=1.0) is False
+        assert node.is_running()    # node survives, retry loop armed
+    finally:
+        node.stop()
+        srv.close()
