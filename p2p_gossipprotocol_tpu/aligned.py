@@ -448,10 +448,15 @@ class AlignedSimulator:
                    max_strikes=cfg.max_missed_pings,
                    # probe cadence from the config's own intervals: one
                    # liveness sweep per ping_interval of message rounds
-                   # (reference defaults 13 s / 5 s → every 3rd round)
+                   # (reference defaults 13 s / 5 s → every 3rd round).
+                   # Sub-second message intervals keep their real ratio
+                   # (ping=13, message=0.5 → every 26th round); only a
+                   # zero/negative denominator falls back to 1:1.
                    liveness_every=max(1, round(
                        cfg.get_ping_interval()
-                       / max(cfg.get_message_interval(), 1))),
+                       / (cfg.get_message_interval()
+                          if cfg.get_message_interval() > 0
+                          else cfg.get_ping_interval()))),
                    seed=cfg.prng_seed)
 
     # ------------------------------------------------------------------

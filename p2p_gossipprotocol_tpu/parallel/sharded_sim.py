@@ -112,6 +112,23 @@ class ShardedSimulator:
             n_honest_msgs=self._n_honest)
         return shard_state(global_state, self.stopo, self.mesh)
 
+    def place_topo(self, topo) -> ShardedTopology:
+        """Lay a topology out on the mesh.  Accepts either the
+        already-partitioned :class:`ShardedTopology` (e.g. restored from
+        a checkpoint, where it comes back committed to one device and
+        would conflict with the mesh-sharded state) or a host-global
+        :class:`Topology` (partitioned here first — same contract as the
+        aligned engines' ``shard_topo``)."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        if not isinstance(topo, ShardedTopology):
+            topo = partition_topology(topo, self.n_shards)
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), topo.spec(),
+            is_leaf=lambda x: isinstance(x, P))
+        return jax.device_put(topo, shardings)
+
     # ------------------------------------------------------------------
     # Local (per-shard) round pieces.  All arrays are this shard's block;
     # src/dst/nbr indices are GLOBAL peer ids.
@@ -281,13 +298,18 @@ class ShardedSimulator:
         return st_spec, tp_spec, metric_spec
 
     def run(self, rounds: int, state: GossipState | None = None,
-            stopo: ShardedTopology | None = None) -> SimResult:
+            topo: ShardedTopology | None = None) -> SimResult:
         """Fixed-round scan with full metric history, all inside one
-        shard_map (collectives compiled into the loop body)."""
+        shard_map (collectives compiled into the loop body).
+
+        The topology parameter is named ``topo`` like every other
+        engine's ``run`` so utils.checkpoint.run_chunked can thread the
+        churn-mutated topology between chunks uniformly (it detects the
+        kwarg by name)."""
         import time as _time
 
         state = self.init_state() if state is None else state
-        stopo = self.stopo if stopo is None else stopo
+        stopo = self.stopo if topo is None else self.place_topo(topo)
 
         if rounds not in self._run_cache:
             st_spec, tp_spec, metric_spec = self._specs()

@@ -40,6 +40,14 @@ def restore(path: str, target):
     return restored
 
 
+def running_topo(sim):
+    """The engine's RUNNING topology — what its ``run()`` threads through
+    chunks and what a checkpoint must restore against.  ShardedSimulator
+    carries the partitioned one in ``.stopo``; every other engine runs
+    its host-built ``.topo``."""
+    return getattr(sim, "stopo", sim.topo)
+
+
 def run_chunked(sim, rounds: int, *, every: int, state=None, topo=None,
                 hist=None, wall: float = 0.0, done: int = 0,
                 after_chunk=None, should_stop=None):
@@ -84,7 +92,8 @@ def run_chunked(sim, rounds: int, *, every: int, state=None, topo=None,
         from p2p_gossipprotocol_tpu.sim import SimResult, SIRResult
 
         result_cls = SimResult if "coverage" in hist else SIRResult
-        topo = sim.topo if topo is None else topo
+        if topo is None:
+            topo = running_topo(sim)
     result = result_cls(state=state, topo=topo, wall_s=wall, **hist)
     return result, state, topo, hist, wall, done
 
@@ -132,7 +141,7 @@ def run_with_checkpoints(sim, rounds: int, *, every: int, directory: str,
             hist = {k: m[k] for k in m.files
                     if k not in ("rounds_done", "wall_s")}
             wall = float(m["wall_s"])
-        target = {"state": sim.init_state(), "topo": sim.topo}
+        target = {"state": sim.init_state(), "topo": running_topo(sim)}
         restored = restore(os.path.join(directory, f"state_{done}"),
                            target)
         state, topo = restored["state"], restored["topo"]

@@ -150,6 +150,38 @@ def test_run_with_checkpoints_sharded(tmp_path, devices8):
                                   np.asarray(full.topo.colidx))
 
 
+def test_run_with_checkpoints_edges_sharded(tmp_path, devices8):
+    """The EDGES-sharded engine under the runner, churn on: the chunked
+    run must thread the churn-mutated ShardedTopology between chunks
+    (run() takes it as ``topo`` like every other engine) and a fresh
+    process must resume against the sharded — not the host-global —
+    topology structure.  Round-4 advisor finding: the kwarg was named
+    ``stopo``, so chunking silently reset edge_mask/dst each chunk."""
+    from p2p_gossipprotocol_tpu.parallel import ShardedSimulator, make_mesh
+
+    topo = graph.erdos_renyi(seed=7, n=1024, avg_degree=6)
+
+    def mk():
+        return ShardedSimulator(
+            topo=topo, mesh=make_mesh(8), n_msgs=8, mode="pushpull",
+            churn=ChurnConfig(rate=0.05, kill_round=1), max_strikes=2,
+            seed=3)
+
+    full = mk().run(8)
+    d = str(tmp_path / "ck")
+    checkpoint.run_with_checkpoints(mk(), 4, every=2, directory=d)
+    resumed = checkpoint.run_with_checkpoints(mk(), 8, every=2,
+                                              directory=d, resume=True)
+    np.testing.assert_array_equal(resumed.coverage, full.coverage)
+    np.testing.assert_array_equal(resumed.evictions, full.evictions)
+    np.testing.assert_array_equal(np.asarray(resumed.state.seen),
+                                  np.asarray(full.state.seen))
+    np.testing.assert_array_equal(np.asarray(resumed.topo.dst),
+                                  np.asarray(full.topo.dst))
+    np.testing.assert_array_equal(np.asarray(resumed.topo.edge_mask),
+                                  np.asarray(full.topo.edge_mask))
+
+
 def test_run_with_checkpoints_sir(tmp_path):
     """The runner's claim covers the SIR engines too: an interrupted
     epidemic census resumes into the same curve an uninterrupted run
