@@ -1,0 +1,39 @@
+#!/bin/bash
+# TPU tunnel watchdog (round-5 verdict item 1): probe the axon backend
+# with a hard-kill timeout (jax.devices() HANGS in C when the tunnel is
+# down — a plain timeout won't kill it); the moment a probe succeeds,
+# run the measurement chain:
+#   1. benchmarks/mosaic_smoke.py  — Mosaic compile gate, every kernel
+#      variant, bitwise vs interpret
+#   2. bench.py                    — the driver's headline metric
+#   3. benchmarks/measure_round4.py — stride/roll-group A/B at 1M,
+#      10M x 256 headline, 10M SIR, profiler trace
+# Probes every 90 s; everything appends to benchmarks/results/.
+set -u
+cd /root/repo
+LOG=${GOSSIP_WATCHDOG_LOG:-benchmarks/results/watchdog_r5.log}
+mkdir -p benchmarks/results
+export PYTHONPATH=/root/repo:/root/.axon_site
+
+say() { echo "$(date -u +%FT%TZ) $*" >>"$LOG"; }
+
+say "watchdog start (pid $$)"
+while true; do
+  if timeout -k 10 120 python -c \
+      "import jax, jax.numpy as jnp; \
+       jax.jit(lambda x: x + 1)(jnp.ones((8, 128))).block_until_ready(); \
+       print(jax.devices())" >>"$LOG" 2>&1; then
+    say "tunnel UP — running measurement chain"
+    timeout -k 30 2400 python benchmarks/mosaic_smoke.py >>"$LOG" 2>&1
+    say "mosaic_smoke exit=$?"
+    timeout -k 30 3600 python bench.py \
+      >benchmarks/results/bench_r5_tpu.json 2>>"$LOG"
+    say "bench exit=$?"
+    timeout -k 30 7200 python benchmarks/measure_round4.py >>"$LOG" 2>&1
+    say "measure_round4 exit=$?"
+    say "measurement chain done"
+    exit 0
+  fi
+  say "tunnel down"
+  sleep 90
+done

@@ -56,15 +56,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="jax mode: exact edge-list engine, or the "
                         "hardware-aligned pallas engine (1M+ peers); "
                         "default: the config's engine= key (edges)")
-    p.add_argument("--mesh-devices", type=int, default=0, metavar="N",
+    p.add_argument("--mesh-devices", type=int, default=None, metavar="N",
                    help="jax mode: shard the peer axis over an N-device "
                         "mesh (ShardedSimulator / "
-                        "AlignedShardedSimulator); 0 = single device")
-    p.add_argument("--msg-shards", type=int, default=0, metavar="M",
+                        "AlignedShardedSimulator); 0 = single device; "
+                        "default: the mesh_devices= config key")
+    p.add_argument("--msg-shards", type=int, default=None, metavar="M",
                    help="with --engine aligned and --mesh-devices N: "
                         "also shard the message planes, as an "
                         "M x (N/M) (msgs x peers) 2-D mesh "
-                        "(Aligned2DShardedSimulator); 0 = peers only")
+                        "(Aligned2DShardedSimulator); 0 = peers only; "
+                        "default: the msg_shards= config key")
     p.add_argument("--target-coverage", type=float, default=0.99)
     p.add_argument("--local-ip", default=None)
     p.add_argument("--local-port", type=int, default=None)
@@ -106,124 +108,57 @@ def _run_sim(sim, rounds, args):
 
 
 def _run_jax(cfg: NetworkConfig, args) -> int:
+    from p2p_gossipprotocol_tpu.engines import build_simulator
     from p2p_gossipprotocol_tpu.utils import metrics as metrics_lib
 
     rounds = args.rounds or cfg.rounds or 64
-    if args.mesh_devices > 1:
-        # Fail fast BEFORE topology construction — building a 10M-peer
-        # overlay only to learn the mesh doesn't exist wastes tens of
-        # seconds and GBs of host RAM.
-        import jax
-
-        have = len(jax.devices())
-        if args.mesh_devices > have:
-            print(f"Error: requested {args.mesh_devices} devices, "
-                  f"have {have}", file=sys.stderr)
-            return 1
-    with metrics_lib.profile(args.profile_dir):
-        if cfg.mode == "sir":
-            if args.engine == "aligned":
-                return _run_jax_sir_aligned(cfg, args, rounds, metrics_lib)
-            if args.mesh_devices > 1:
-                print("Error: --mesh-devices with the SIR model needs "
-                      "--engine aligned (the edges SIR engine is "
-                      "single-device)", file=sys.stderr)
-                return 1
-            return _run_jax_sir(cfg, args, rounds, metrics_lib)
-        if args.engine == "aligned":
-            return _run_jax_aligned(cfg, args, rounds, metrics_lib)
-
-        from p2p_gossipprotocol_tpu.sim import Simulator
-
-        sim = Simulator.from_config(cfg, n_peers=args.n_peers)
-        engine = "edges"
-        if args.mesh_devices > 1:
-            # Same scenario, sharded over the mesh: from_config resolved
-            # every knob (junk columns, churn, strikes); lift them onto
-            # the drop-in multi-chip simulator.
-            from p2p_gossipprotocol_tpu.parallel import (ShardedSimulator,
-                                                         make_mesh)
-
-            try:
-                sim = ShardedSimulator(
-                    topo=sim.topo, mesh=make_mesh(args.mesh_devices),
-                    n_msgs=sim.n_msgs, mode=sim.mode, fanout=sim.fanout,
-                    churn=sim.churn,
-                    byzantine_fraction=sim.byzantine_fraction,
-                    n_honest_msgs=sim.n_honest_msgs,
-                    max_strikes=sim.max_strikes, seed=sim.seed)
-            except ValueError as e:
-                print(f"Error: {e}", file=sys.stderr)
-                return 1
-            engine = f"edges-sharded-{args.mesh_devices}"
-        if not args.quiet:
-            print(f"[jax] simulating {sim.topo.n_peers} peers, "
-                  f"{sim.n_msgs} messages, mode={sim.mode}, "
-                  f"{int(sim.topo.n_edges())} edges, engine={engine}")
-        res = _run_sim(sim, rounds, args)
-    _report(res, sim, n_peers=sim.topo.n_peers, engine=engine,
-            args=args, metrics_lib=metrics_lib,
-            graph_backend=cfg.graph_backend)
-    return 0
-
-
-def _run_jax_sir(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
-    """Drive the SIR epidemic model (BASELINE config 3: BA-100k) through
-    the same report path as the gossip engines: per-round census lines,
-    optional JSONL, one summary JSON line with the epidemic-curve fields
-    (S/I/R, peak_infected, attack_rate)."""
-    from p2p_gossipprotocol_tpu.sim import SIRSimulator
-
-    sim = SIRSimulator.from_config(cfg, n_peers=args.n_peers)
-    if not args.quiet:
-        print(f"[jax/sir] simulating {sim.topo.n_peers} peers, "
-              f"beta={sim.beta:g}, gamma={sim.gamma:g}, "
-              f"{int(sim.topo.n_edges())} edges")
-    res = _run_sim(sim, rounds, args)
-    _report_sir(res, n_peers=sim.topo.n_peers, engine="edges", args=args,
-                metrics_lib=metrics_lib, graph_backend=cfg.graph_backend)
-    return 0
-
-
-def _run_jax_sir_aligned(cfg: NetworkConfig, args, rounds,
-                         metrics_lib) -> int:
-    """BASELINE config 3 on the scale path: the aligned overlay's SIR
-    engine (aligned_sir.py), single-chip or sharded over --mesh-devices."""
-    from p2p_gossipprotocol_tpu.aligned_sir import AlignedSIRSimulator
-
     clamps: list[str] = []
-    n_shards = max(1, args.mesh_devices)
     try:
-        sim = AlignedSIRSimulator.from_config(cfg, n_peers=args.n_peers,
-                                              n_shards=n_shards,
-                                              clamps=clamps)
+        # THE engine-selection table (engines.build_simulator) — shared
+        # with wrapper.Peer, so CLI flags and config keys cannot drift.
+        sim, engine = build_simulator(
+            cfg, n_peers=args.n_peers, mesh_devices=args.mesh_devices,
+            msg_shards=args.msg_shards, clamps=clamps)
     except ValueError as e:
+        # fail cleanly (values --engine edges accepts but the aligned
+        # ceilings reject, impossible mesh layouts, ...) instead of
+        # leaking a traceback
         print(f"Error: {e}", file=sys.stderr)
         return 1
     for c in clamps:
         print(f"Warning: --engine aligned clamped {c}", file=sys.stderr)
-    engine = "aligned"
-    if n_shards > 1:
-        from p2p_gossipprotocol_tpu.parallel import (
-            AlignedShardedSIRSimulator, make_mesh)
-
-        try:
-            sim = AlignedShardedSIRSimulator(
-                mesh=make_mesh(n_shards), topo=sim.topo, beta=sim.beta,
-                gamma=sim.gamma, n_seeds=sim.n_seeds, churn=sim.churn,
-                seed=sim.seed)
-        except ValueError as e:
-            print(f"Error: {e}", file=sys.stderr)
-            return 1
-        engine = f"aligned-sharded-{n_shards}"
     n = sim.topo.n_peers
     if not args.quiet:
-        print(f"[jax/sir] simulating {n} peers, beta={cfg.sir_beta:g}, "
-              f"gamma={cfg.sir_gamma:g}, {sim.topo.n_slots} slots/peer, "
-              f"engine={engine}")
-    res = _run_sim(sim, rounds, args)
-    _report_sir(res, n_peers=n, engine=engine, args=args,
-                metrics_lib=metrics_lib, clamps=clamps)
+        if cfg.mode == "sir":
+            detail = (f"{sim.topo.n_slots} slots/peer"
+                      if engine.startswith("aligned")
+                      else f"{int(sim.topo.n_edges())} edges")
+            print(f"[jax/sir] simulating {n} peers, "
+                  f"beta={cfg.sir_beta:g}, gamma={cfg.sir_gamma:g}, "
+                  f"{detail}, engine={engine}")
+        elif engine.startswith("aligned"):
+            print(f"[jax/aligned] simulating {n} peers, {sim.n_msgs} "
+                  f"messages, mode={sim.mode}, "
+                  f"{sim.topo.n_slots} slots/peer, "
+                  f"churn={cfg.churn_rate:g}, "
+                  f"byzantine={cfg.byzantine_fraction:g}, "
+                  f"engine={engine}")
+        else:
+            print(f"[jax] simulating {n} peers, "
+                  f"{sim.n_msgs} messages, mode={sim.mode}, "
+                  f"{int(sim.topo.n_edges())} edges, engine={engine}")
+    with metrics_lib.profile(args.profile_dir):
+        res = _run_sim(sim, rounds, args)
+    graph_backend = (cfg.graph_backend if engine.startswith("edges")
+                     else None)
+    if cfg.mode == "sir":
+        _report_sir(res, n_peers=n, engine=engine, args=args,
+                    metrics_lib=metrics_lib, clamps=clamps or None,
+                    graph_backend=graph_backend)
+    else:
+        _report(res, sim, n_peers=n, engine=engine, args=args,
+                metrics_lib=metrics_lib, clamps=clamps or None,
+                graph_backend=graph_backend)
     return 0
 
 
@@ -270,74 +205,6 @@ def _report_sir(res, *, n_peers, engine, args, metrics_lib,
     if clamps:
         out["clamped"] = clamps
     print(json.dumps(out))
-
-
-def _run_jax_aligned(cfg: NetworkConfig, args, rounds, metrics_lib) -> int:
-    from p2p_gossipprotocol_tpu.aligned import AlignedSimulator
-
-    clamps: list[str] = []
-    n_shards = max(1, args.mesh_devices)
-    try:
-        # from_config owns every engine ceiling (overlay family, 2048-
-        # message cap, byzantine junk budget, int8 strike range, VMEM
-        # row-block budget) — shared with the wrapper facade.
-        sim = AlignedSimulator.from_config(cfg, n_peers=args.n_peers,
-                                           n_shards=n_shards,
-                                           clamps=clamps)
-    except ValueError as e:
-        # fail cleanly like the mode/fanout checks instead of leaking a
-        # traceback (values --engine edges accepts, e.g. max_missed_pings
-        # outside the int8 strike range)
-        print(f"Error: {e}", file=sys.stderr)
-        return 1
-    for c in clamps:
-        print(f"Warning: --engine aligned clamped {c}", file=sys.stderr)
-    engine = "aligned"
-    if n_shards > 1:
-        lifted = dict(
-            topo=sim.topo, n_msgs=sim.n_msgs, mode=sim.mode,
-            fanout=sim.fanout, churn=sim.churn,
-            byzantine_fraction=sim.byzantine_fraction,
-            n_honest_msgs=sim.n_honest_msgs,
-            max_strikes=sim.max_strikes,
-            liveness_every=sim.liveness_every, seed=sim.seed)
-        try:
-            if args.msg_shards > 1:
-                # 2-D mesh: message planes x peer rows (the SP analogue,
-                # parallel/aligned_2d.py)
-                from p2p_gossipprotocol_tpu.parallel import (
-                    Aligned2DShardedSimulator, make_mesh_2d)
-
-                if n_shards % args.msg_shards:
-                    print(f"Error: --msg-shards {args.msg_shards} does "
-                          f"not divide --mesh-devices {n_shards}",
-                          file=sys.stderr)
-                    return 1
-                peer_shards = n_shards // args.msg_shards
-                sim = Aligned2DShardedSimulator(
-                    mesh=make_mesh_2d(args.msg_shards, peer_shards),
-                    **lifted)
-                engine = (f"aligned-2d-{args.msg_shards}x{peer_shards}")
-            else:
-                from p2p_gossipprotocol_tpu.parallel import (
-                    AlignedShardedSimulator, make_mesh)
-
-                sim = AlignedShardedSimulator(
-                    mesh=make_mesh(n_shards), **lifted)
-                engine = f"aligned-sharded-{n_shards}"
-        except ValueError as e:
-            print(f"Error: {e}", file=sys.stderr)
-            return 1
-    n = sim.topo.n_peers
-    if not args.quiet:
-        print(f"[jax/aligned] simulating {n} peers, {sim.n_msgs} "
-              f"messages, mode={sim.mode}, {sim.topo.n_slots} slots/peer, "
-              f"churn={cfg.churn_rate:g}, "
-              f"byzantine={cfg.byzantine_fraction:g}, engine={engine}")
-    res = _run_sim(sim, rounds, args)
-    _report(res, sim, n_peers=n, engine=engine,
-            args=args, metrics_lib=metrics_lib, clamps=clamps)
-    return 0
 
 
 def _report(res, sim, *, n_peers, engine, args, metrics_lib, clamps=None,
@@ -438,14 +305,12 @@ def main(argv: list[str] | None = None) -> int:
     if args.engine:
         cfg.engine = args.engine
     args.engine = cfg.engine
-
-    if args.msg_shards > 1 and (cfg.engine != "aligned"
-                                or args.mesh_devices <= 1
-                                or cfg.mode == "sir"):
-        print("Error: --msg-shards needs --engine aligned, "
-              "--mesh-devices > 1, and a gossip mode (the 2-D mesh "
-              "shards the bit-packed message planes)", file=sys.stderr)
-        return 1
+    # flags override the config keys; absent flags fall back to them, so
+    # a config file alone selects any engine (same table as the facade)
+    if args.mesh_devices is None:
+        args.mesh_devices = cfg.mesh_devices
+    if args.msg_shards is None:
+        args.msg_shards = cfg.msg_shards
     if (args.checkpoint_every > 0 or args.resume) \
             and not args.checkpoint_dir:
         print("Error: --checkpoint-every/--resume need --checkpoint-dir",

@@ -106,6 +106,14 @@ _SIM_INT_KEYS = {
     "roll_groups": "roll_groups",
     "rounds": "rounds",
     "prng_seed": "prng_seed",
+    # jax backend: shard the peer axis over an N-device mesh (0/1 =
+    # single device) — the config-file twin of --mesh-devices, so a
+    # deployment can reach the sharded engines without CLI flags.
+    "mesh_devices": "mesh_devices",
+    # with engine=aligned and mesh_devices=N: also shard the bit-packed
+    # message planes, as an M x (N/M) (msgs x peers) 2-D mesh — the
+    # config-file twin of --msg-shards.
+    "msg_shards": "msg_shards",
     # Socket mode: seconds between anti-entropy pulls (0 = off, the
     # reference's behavior — its flood-once push loses every message
     # generated before a connection existed, peer.cpp:297-318).
@@ -161,6 +169,8 @@ class NetworkConfig:
         self.fanout = 0
         self.roll_groups = 0           # aligned engine; 0 = per-slot rolls
         self.rounds = 0
+        self.mesh_devices = 0          # 0/1 = single device
+        self.msg_shards = 0            # 0/1 = peer-axis sharding only
         self.churn_rate = 0.0
         self.byzantine_fraction = 0.0
         self.powerlaw_alpha = 2.5
@@ -284,9 +294,13 @@ class NetworkConfig:
             raise ConfigError(f"Invalid local_port: {self.local_port}")
         for k in ("n_peers", "n_messages", "avg_degree", "ba_m", "fanout",
                   "roll_groups", "rounds", "prng_seed",
-                  "anti_entropy_interval"):
+                  "anti_entropy_interval", "mesh_devices", "msg_shards"):
             if getattr(self, k) < 0:
                 raise ConfigError(f"{k} must be non-negative")
+        # msg_shards/mesh_devices CROSS-field rules are deliberately not
+        # checked here: CLI flags may override engine/mode/mesh after
+        # load, so the combination is validated at engine-selection time
+        # (engines.build_simulator), the one place both surfaces share.
         if self.backend not in ("jax", "socket"):
             raise ConfigError(f"Unknown backend: {self.backend}")
         if self.graph not in ("reference", "er", "ba", "powerlaw"):

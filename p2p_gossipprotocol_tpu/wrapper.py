@@ -51,31 +51,15 @@ class Peer:
             #: engine ceilings from_config had to apply (aligned engine
             #: only; surfaced, never silent — same contract as the CLI)
             self.clamps: list[str] = []
-            if cfg.engine == "aligned":
-                # The scale engines (1M+ peers) through the same
-                # reference-parity facade — engine= in the config file
-                # is all it takes (round-3 judge: the facade previously
-                # always built the edges engine).
-                if cfg.mode == "sir":
-                    from p2p_gossipprotocol_tpu.aligned_sir import \
-                        AlignedSIRSimulator
+            # THE engine-selection table (engines.build_simulator,
+            # shared with the CLI): engine= picks the family, and
+            # mesh_devices= / msg_shards= reach the sharded and 2-D
+            # engines — a config file alone selects every engine in the
+            # repo through this reference-parity facade.
+            from p2p_gossipprotocol_tpu.engines import build_simulator
 
-                    self._sim = AlignedSIRSimulator.from_config(
-                        cfg, clamps=self.clamps)
-                else:
-                    from p2p_gossipprotocol_tpu.aligned import \
-                        AlignedSimulator
-
-                    self._sim = AlignedSimulator.from_config(
-                        cfg, clamps=self.clamps)
-            elif cfg.mode == "sir":
-                from p2p_gossipprotocol_tpu.sim import SIRSimulator
-
-                self._sim = SIRSimulator.from_config(cfg)
-            else:
-                from p2p_gossipprotocol_tpu.sim import Simulator
-
-                self._sim = Simulator.from_config(cfg)
+            self._sim, self.engine = build_simulator(
+                cfg, clamps=self.clamps)
             self._running = False
             self._stop_event = threading.Event()
             self.rounds_completed = 0   # chunks landed so far (jax)

