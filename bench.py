@@ -134,6 +134,9 @@ def _bench_aligned(n, n_msgs, degree, mode):
     # Distinct block rolls (DMA-reuse layout, build_aligned docstring);
     # 0 = one per slot (fully random).
     roll_groups = int(os.environ.get("GOSSIP_BENCH_ROLL_GROUPS", "4")) or None
+    # Staggered generation: message m enters at round m*k (the
+    # reference's messageGenerationLoop cadence); 0 = all at round 0.
+    stagger = int(os.environ.get("GOSSIP_BENCH_STAGGER", "0"))
     t0 = time.perf_counter()
     topo = build_aligned(seed=0, n=n, n_slots=degree,
                          degree_law="powerlaw", roll_groups=roll_groups)
@@ -141,6 +144,7 @@ def _bench_aligned(n, n_msgs, degree, mode):
     sim = AlignedSimulator(topo=topo, n_msgs=n_msgs, mode=mode,
                            churn=ChurnConfig(rate=churn_rate, kill_round=1),
                            max_strikes=3, liveness_every=liveness_every,
+                           message_stagger=stagger,
                            seed=0)
     state, topo2, rounds, wall = sim.run_to_coverage(target=TARGET_COV,
                                                      max_rounds=MAX_ROUNDS)
@@ -151,6 +155,7 @@ def _bench_aligned(n, n_msgs, degree, mode):
     extras = {
         "liveness_every": liveness_every,
         "roll_groups": roll_groups,
+        **({"message_stagger": stagger} if stagger else {}),
         # analytic traffic model (aligned.hbm_bytes_per_round) vs the
         # measured wall: how close the engine runs to the ~800 GB/s
         # v5e HBM roof — the round-3 judge's "quantify the gap" ask

@@ -327,6 +327,10 @@ class AlignedSimulator:
     #: setting, and it removes the pass's HBM traffic (colidx + strikes
     #: + alive gather, ~half the round's bytes) from off-rounds.
     liveness_every: int = 1
+    #: rounds between successive message activations: column m enters at
+    #: its source in round m*k (messageGenerationLoop cadence,
+    #: peer.cpp:357-377).  0 = every rumor exists from round 0.
+    message_stagger: int = 0
     seed: int = 0
     interpret: bool | None = None   # None -> interpret unless on TPU
 
@@ -394,6 +398,8 @@ class AlignedSimulator:
                            & ~self._honest_mask)
         self._run_cache: dict = {}
         self._loop_cache: dict = {}
+        if self.message_stagger > 0:
+            self._message_plan()   # eager: a traced cache would leak
 
     # ------------------------------------------------------------------
     @classmethod
@@ -457,6 +463,7 @@ class AlignedSimulator:
                        / (cfg.get_message_interval()
                           if cfg.get_message_interval() > 0
                           else cfg.get_ping_interval()))),
+                   message_stagger=cfg.message_stagger,
                    seed=cfg.prng_seed)
 
     # ------------------------------------------------------------------
@@ -506,7 +513,17 @@ class AlignedSimulator:
         return int(total)
 
     # ------------------------------------------------------------------
-    def init_state(self) -> AlignedState:
+    def _message_plan(self):
+        """(byz_b, src) — the byzantine draw and per-column source
+        positions (flat ``row*128 + lane`` ids), deterministic in the
+        seed so init_state and the staggered in-round generation
+        (aligned_round) place every rumor identically.
+
+        Honest rumors must originate at honest peers (a byzantine source
+        never relays — state.py:message_sources has the same rule);
+        sources spread evenly over the honest population."""
+        if getattr(self, "_plan_cache", None) is not None:
+            return self._plan_cache
         rows = self.topo.rows
         key = jax.random.PRNGKey(self.seed)
         k_byz, key = jax.random.split(key)
@@ -516,19 +533,27 @@ class AlignedSimulator:
                      < self.byzantine_fraction) & valid_b
         else:
             byz_b = jnp.zeros((rows, LANES), bool)
-        byz_w = jnp.where(byz_b, jnp.int32(-1), jnp.int32(0))
-        # Honest rumors must originate at honest peers (a byzantine source
-        # never relays — state.py:init_gossip_state has the same rule).
-        # Sources spread evenly over the honest population; columns >=
-        # n_honest start empty (the adversary's injection budget).
         ok_flat = (valid_b & ~byz_b).reshape(-1)
         honest_idx = jnp.nonzero(ok_flat, size=rows * LANES,
                                  fill_value=0)[0]
         n_ok = jnp.maximum(jnp.sum(ok_flat, dtype=jnp.int32), 1)
         stride = jnp.maximum(n_ok // max(self._n_honest, 1), 1)
         pos = (jnp.arange(self.n_msgs, dtype=jnp.int32) * stride) % n_ok
-        src = honest_idx[pos]
-        place = jnp.arange(self.n_msgs) < self._n_honest
+        self._plan_cache = (byz_b, honest_idx[pos])
+        return self._plan_cache
+
+    def init_state(self) -> AlignedState:
+        rows = self.topo.rows
+        key = jax.random.PRNGKey(self.seed)
+        _, key = jax.random.split(key)     # k_byz consumed by the plan
+        valid_b = self.topo.valid_w != 0
+        byz_b, src = self._message_plan()
+        byz_w = jnp.where(byz_b, jnp.int32(-1), jnp.int32(0))
+        # Columns >= n_honest start empty (the adversary's injection
+        # budget); with staggered generation NO columns are seeded here
+        # — column m is injected at round m*k by aligned_round.
+        place = ((jnp.arange(self.n_msgs) < self._n_honest)
+                 & (self.message_stagger <= 0))
         # Seed words in uint32 with scatter-ADD: distinct message bits add
         # like OR (so colliding sources keep every rumor — every message
         # is a distinct (plane, bit) pair), and bit 31 survives (an int32
@@ -611,10 +636,16 @@ class AlignedSimulator:
         topo = self.topo if topo is None else topo
         cache_key = (target, max_rounds)
         if cache_key not in self._loop_cache:
+            from p2p_gossipprotocol_tpu.state import stagger_sched_end
+
+            sched_end = stagger_sched_end(self._n_honest,
+                                          self.message_stagger)
+
             def looped(st, tp):
                 def cond(carry):
                     st, tp, cov = carry
-                    return (cov < target) & (st.round < max_rounds)
+                    return (((cov < target) | (st.round < sched_end))
+                            & (st.round < max_rounds))
 
                 def body(carry):
                     st, tp, _ = carry
@@ -649,14 +680,25 @@ def aligned_coverage(sim: AlignedSimulator, state: AlignedState,
     n_ok = max(int(jax.device_get(_popcount_sum(ok_w))) >> 5, 1)
     hits = int(jax.device_get(_popcount_sum(
         state.seen_w & ok_w[None] & sim._honest_mask[:, None, None])))
-    return hits / (n_ok * sim._n_honest)
+    n_cols = sim._n_honest
+    if sim.message_stagger > 0:
+        # columns GENERATED so far (aligned_round's denominator rule):
+        # a plane-wise OR leaves the nonempty-column bits, popcounted
+        # under the honest mask — same jnp ops as the in-loop census
+        or_w = jax.lax.reduce(state.seen_w, jnp.int32(0),
+                              jax.lax.bitwise_or, (1, 2))
+        n_cols = int(jax.device_get(
+            _popcount_sum(or_w & sim._honest_mask)))
+    return hits / (n_ok * max(n_cols, 1))
 
 
 def aligned_round(sim: AlignedSimulator, state: AlignedState,
                   topo: AlignedTopology, *, grows: jax.Array,
                   t_off: jax.Array, gather, reduce,
                   msg_reduce=None, honest_mask: jax.Array | None = None,
-                  junk_mask: jax.Array | None = None
+                  junk_mask: jax.Array | None = None,
+                  w_off: jax.Array | int = 0,
+                  msg_only_reduce=None
                   ) -> tuple[AlignedState, AlignedTopology, dict]:
     """THE round implementation, shared by the single-chip engine,
     AlignedShardedSimulator (parallel/aligned_sharded.py) and the 2-D
@@ -682,6 +724,8 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
     metrics — is this one code path, so the engines cannot drift."""
     if msg_reduce is None:
         msg_reduce = reduce
+    if msg_only_reduce is None:        # sums over MESSAGE shards only —
+        msg_only_reduce = (lambda x: x)  # identity unless planes shard
     hmask = sim._honest_mask if honest_mask is None else honest_mask
     jmask = sim._junk_mask if junk_mask is None else junk_mask
     def prow(x):   # apply the row permutation on the rows (ndim-2) axis
@@ -743,6 +787,45 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
         seen_w = seen_w | inject
         frontier_w = frontier_w | inject
 
+    if sim.message_stagger > 0:
+        # Staggered generation: round m*k injects column m's bit at its
+        # source (the messageGenerationLoop tick, peer.cpp:357-377) —
+        # one dynamic single-element update, no plane-sized traffic.
+        # Runs after churn, so a source that died before its activation
+        # round never generates (the reference's generation thread stops
+        # with its process); the frontier bit is relayed THIS round,
+        # like the round-0 seeding.  All coordinates derive from the
+        # replicated round scalar + the deterministic plan, so every
+        # shard computes the same global decision and applies it only if
+        # the (plane, row) cell is local.
+        k = sim.message_stagger
+        r = state.round
+        m = r // k
+        _, srcs = sim._message_plan()
+        src = srcs[jnp.clip(m, 0, sim.n_msgs - 1)]
+        grow, lane = src // LANES, src % LANES
+        W_l, rows_l = seen_w.shape[0], seen_w.shape[1]
+        lrow = grow - grows[0]
+        lw = (m // WORD_BITS) - w_off
+        safe_r = jnp.clip(lrow, 0, rows_l - 1)
+        safe_w = jnp.clip(lw, 0, W_l - 1)
+        src_alive = jax.lax.dynamic_slice(
+            alive_b, (safe_r, lane), (1, 1))[0, 0]
+        do = ((r % k == 0) & (m < sim._n_honest) & src_alive
+              & (lrow >= 0) & (lrow < rows_l)
+              & (lw >= 0) & (lw < W_l))
+        bit = jnp.where(do,
+                        jnp.left_shift(jnp.int32(1), m % WORD_BITS),
+                        jnp.int32(0))
+        cell = (safe_w, safe_r, lane)
+        seen_w = jax.lax.dynamic_update_slice(
+            seen_w,
+            jax.lax.dynamic_slice(seen_w, cell, (1, 1, 1)) | bit, cell)
+        frontier_w = jax.lax.dynamic_update_slice(
+            frontier_w,
+            jax.lax.dynamic_slice(frontier_w, cell, (1, 1, 1)) | bit,
+            cell)
+
     if sim.mode in ("push", "pushpull"):
         # Dead peers don't send; byzantine peers never relay (suppression,
         # models/gossip.py:50-58) — both masked at the source words.
@@ -791,10 +874,29 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
     # bits to popcount(ok_w), hence the >> 5 peer count.
     ok_w = alive_w & ~state.byz_w & topo.valid_w
     n_ok = jnp.maximum(reduce(_popcount_sum(ok_w)) >> 5, 1)
+    if sim.message_stagger > 0:
+        # mean over the columns GENERATED so far (sim.py:coverage_of has
+        # the rationale: a rumor that doesn't exist — not yet scheduled,
+        # or lost to a pre-activation source death — can't count against
+        # coverage).  Generated derives from the seen planes themselves:
+        # OR over rows+lanes leaves one word per plane whose set bits
+        # are the nonempty columns; cross-shard, the OR rides a psum of
+        # the unpacked bits.
+        or_w = jax.lax.reduce(seen, jnp.int32(0), jax.lax.bitwise_or,
+                              (1, 2))
+        shifts = jnp.arange(WORD_BITS, dtype=jnp.int32)
+        bits = (or_w[:, None] >> shifts) & 1
+        hbits = (hmask[:, None] >> shifts) & 1
+        gen = (reduce(bits) > 0) & (hbits > 0)
+        n_cols = jnp.maximum(
+            msg_only_reduce(jnp.sum(gen, dtype=jnp.int32)),
+            1).astype(jnp.float32)
+    else:
+        n_cols = jnp.float32(sim._n_honest)
     coverage = (msg_reduce(_popcount_sum(
         seen & ok_w[None] & hmask[:, None, None]))
                 .astype(jnp.float32)
-                / (n_ok.astype(jnp.float32) * sim._n_honest))
+                / (n_ok.astype(jnp.float32) * n_cols))
     live = reduce(_popcount_sum(alive_w & topo.valid_w)) >> 5
     state = AlignedState(seen_w=seen, frontier_w=new, alive_b=alive_b,
                          byz_w=state.byz_w, strikes=strikes, key=key,

@@ -311,6 +311,13 @@ def main(argv: list[str] | None = None) -> int:
         args.mesh_devices = cfg.mesh_devices
     if args.msg_shards is None:
         args.msg_shards = cfg.msg_shards
+    if cfg.backend != "jax" and (args.mesh_devices > 1
+                                 or args.msg_shards > 1):
+        # fail fast, not a silent socket run the user believes is sharded
+        print("Error: --mesh-devices/--msg-shards are jax-backend "
+              "features (the socket runtime is one real peer process)",
+              file=sys.stderr)
+        return 1
     if (args.checkpoint_every > 0 or args.resume) \
             and not args.checkpoint_dir:
         print("Error: --checkpoint-every/--resume need --checkpoint-dir",

@@ -106,6 +106,12 @@ _SIM_INT_KEYS = {
     "roll_groups": "roll_groups",
     "rounds": "rounds",
     "prng_seed": "prng_seed",
+    # jax backend: rounds between successive message activations —
+    # column m enters the network at round m*k, the cadence of the
+    # reference's messageGenerationLoop (one message per
+    # message_interval, peer.cpp:357-377; one round ≈ one interval, so
+    # 1 is the faithful timeline).  0 = every rumor exists from round 0.
+    "message_stagger": "message_stagger",
     # jax backend: shard the peer axis over an N-device mesh (0/1 =
     # single device) — the config-file twin of --mesh-devices, so a
     # deployment can reach the sharded engines without CLI flags.
@@ -169,6 +175,7 @@ class NetworkConfig:
         self.fanout = 0
         self.roll_groups = 0           # aligned engine; 0 = per-slot rolls
         self.rounds = 0
+        self.message_stagger = 0       # 0 = all rumors at round 0
         self.mesh_devices = 0          # 0/1 = single device
         self.msg_shards = 0            # 0/1 = peer-axis sharding only
         self.churn_rate = 0.0
@@ -294,7 +301,8 @@ class NetworkConfig:
             raise ConfigError(f"Invalid local_port: {self.local_port}")
         for k in ("n_peers", "n_messages", "avg_degree", "ba_m", "fanout",
                   "roll_groups", "rounds", "prng_seed",
-                  "anti_entropy_interval", "mesh_devices", "msg_shards"):
+                  "anti_entropy_interval", "message_stagger",
+                  "mesh_devices", "msg_shards"):
             if getattr(self, k) < 0:
                 raise ConfigError(f"{k} must be non-negative")
         # msg_shards/mesh_devices CROSS-field rules are deliberately not

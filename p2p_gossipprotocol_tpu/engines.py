@@ -116,7 +116,8 @@ def build_simulator(cfg, *, n_peers: int | None = None,
             byzantine_fraction=sim.byzantine_fraction,
             n_honest_msgs=sim.n_honest_msgs,
             max_strikes=sim.max_strikes,
-            liveness_every=sim.liveness_every, seed=sim.seed)
+            liveness_every=sim.liveness_every,
+            message_stagger=sim.message_stagger, seed=sim.seed)
         if msg_shards > 1:
             # 2-D mesh: message planes x peer rows (the SP analogue,
             # parallel/aligned_2d.py)
@@ -145,6 +146,7 @@ def build_simulator(cfg, *, n_peers: int | None = None,
             mode=sim.mode, fanout=sim.fanout, churn=sim.churn,
             byzantine_fraction=sim.byzantine_fraction,
             n_honest_msgs=sim.n_honest_msgs,
-            max_strikes=sim.max_strikes, seed=sim.seed)
+            max_strikes=sim.max_strikes,
+            message_stagger=sim.message_stagger, seed=sim.seed)
         return sim, f"edges-sharded-{n_shards}"
     return sim, "edges"

@@ -88,6 +88,7 @@ class Aligned2DShardedSimulator:
     n_honest_msgs: int | None = None
     max_strikes: int = 3
     liveness_every: int = 1
+    message_stagger: int = 0
     seed: int = 0
     interpret: bool | None = None
 
@@ -102,7 +103,8 @@ class Aligned2DShardedSimulator:
             fanout=self.fanout, churn=self.churn,
             byzantine_fraction=self.byzantine_fraction,
             n_honest_msgs=self.n_honest_msgs, max_strikes=self.max_strikes,
-            liveness_every=self.liveness_every, seed=self.seed,
+            liveness_every=self.liveness_every,
+            message_stagger=self.message_stagger, seed=self.seed,
             interpret=self.interpret)
         self.churn = self._inner.churn
         self.interpret = self._inner.interpret
@@ -159,7 +161,8 @@ class Aligned2DShardedSimulator:
                                                 tiled=True),
             reduce=lambda x: jax.lax.psum(x, PEER_AXIS),
             msg_reduce=lambda x: jax.lax.psum(x, (MSG_AXIS, PEER_AXIS)),
-            honest_mask=hmask, junk_mask=jmask)
+            honest_mask=hmask, junk_mask=jmask, w_off=w0,
+            msg_only_reduce=lambda x: jax.lax.psum(x, MSG_AXIS))
 
     # ------------------------------------------------------------------
     def run(self, rounds: int, state: AlignedState | None = None,
@@ -218,10 +221,16 @@ class Aligned2DShardedSimulator:
             st_spec = _state_spec(self._liveness)
             tp_spec = _topo_spec(self.topo)
 
+            from p2p_gossipprotocol_tpu.state import stagger_sched_end
+
+            sched_end = stagger_sched_end(self._inner._n_honest,
+                                          self.message_stagger)
+
             def looped(st, tp):
                 def cond(carry):
                     st, tp, cov = carry
-                    return (cov < target) & (st.round < max_rounds)
+                    return (((cov < target) | (st.round < sched_end))
+                            & (st.round < max_rounds))
 
                 def body(carry):
                     st, tp, _ = carry

@@ -84,6 +84,7 @@ class AlignedShardedSimulator:
     n_honest_msgs: int | None = None
     max_strikes: int = 3
     liveness_every: int = 1
+    message_stagger: int = 0
     seed: int = 0
     interpret: bool | None = None
 
@@ -105,6 +106,7 @@ class AlignedShardedSimulator:
             churn=self.churn, byzantine_fraction=self.byzantine_fraction,
             n_honest_msgs=self.n_honest_msgs, max_strikes=self.max_strikes,
             liveness_every=self.liveness_every,
+            message_stagger=self.message_stagger,
             seed=self.seed, interpret=self.interpret)
         self.churn = self._inner.churn
         self.interpret = self._inner.interpret
@@ -217,10 +219,16 @@ class AlignedShardedSimulator:
         if cache_key not in self._loop_cache:
             st_spec, tp_spec, _ = self._specs()
 
+            from p2p_gossipprotocol_tpu.state import stagger_sched_end
+
+            sched_end = stagger_sched_end(self._n_honest,
+                                          self.message_stagger)
+
             def looped(st, tp):
                 def cond(carry):
                     st, tp, cov = carry
-                    return (cov < target) & (st.round < max_rounds)
+                    return (((cov < target) | (st.round < sched_end))
+                            & (st.round < max_rounds))
 
                 def body(carry):
                     st, tp, _ = carry

@@ -87,6 +87,9 @@ class ShardedSimulator:
     n_honest_msgs: int | None = None
     max_strikes: int = 3
     rewire: bool = True
+    #: staggered generation (sim.Simulator.message_stagger): column m
+    #: enters at its source in round m*k; 0 = all rumors at round 0.
+    message_stagger: int = 0
     seed: int = 0
 
     def __post_init__(self):
@@ -100,17 +103,37 @@ class ShardedSimulator:
                           if self.n_honest_msgs is not None else self.n_msgs)
         self._run_cache: dict = {}    # rounds -> jitted scan
         self._loop_cache: dict = {}   # (target, max_rounds) -> compiled
+        if self.message_stagger > 0:
+            self._message_plan()   # eager: a traced cache would leak
 
     # ------------------------------------------------------------------
     def init_state(self, sources=None) -> GossipState:
         """Init globally (bitwise-identical for any shard count), then lay
         out on the mesh."""
+        if sources is not None and self.message_stagger > 0:
+            raise ValueError(
+                "custom sources are incompatible with message_stagger "
+                "(staggered generation re-derives the default placement "
+                "each round)")   # sim.Simulator.init_state parity
         key = jax.random.PRNGKey(self.seed)
         global_state = init_gossip_state(
             self.topo, self.n_msgs, key, sources=sources,
             byzantine_fraction=self.byzantine_fraction,
-            n_honest_msgs=self._n_honest)
+            n_honest_msgs=self._n_honest,
+            stagger=self.message_stagger)
         return shard_state(global_state, self.stopo, self.mesh)
+
+    def _message_plan(self) -> jax.Array:
+        """Global per-column source peers — the shared derivation
+        (state.message_plan), so the sharded engine injects staggered
+        rumors at the same peers as the single-chip engine."""
+        if getattr(self, "_plan_cache", None) is None:
+            from p2p_gossipprotocol_tpu.state import message_plan
+
+            self._plan_cache = message_plan(
+                self.seed, self.topo.n_peers, self.byzantine_fraction,
+                self.n_msgs, self._n_honest)
+        return self._plan_cache
 
     def place_topo(self, topo) -> ShardedTopology:
         """Lay a topology out on the mesh.  Accepts either the
@@ -263,6 +286,25 @@ class ShardedSimulator:
         if self._n_honest < self.n_msgs:
             state = inject_byzantine(state, self._n_honest)
 
+        if self.message_stagger > 0:
+            # Staggered generation (sim.Simulator._generate_messages):
+            # round m*k injects column m at its source — every shard
+            # computes the same global gate from the replicated round
+            # scalar + the deterministic plan, and only the shard owning
+            # the source row lands a bit.
+            k = self.message_stagger
+            srcs = self._message_plan()          # global peer ids
+            col = jnp.arange(self.n_msgs, dtype=jnp.int32)
+            lsrc = srcs - lo
+            in_shard = (lsrc >= 0) & (lsrc < topo.block)
+            safe = jnp.clip(lsrc, 0, topo.block - 1)
+            gen = ((col * k == state.round) & (col < self._n_honest)
+                   & in_shard & state.alive[safe]
+                   & ~state.byzantine[safe])
+            bits = jnp.zeros_like(state.seen).at[safe, col].max(gen)
+            state = state.replace(seen=state.seen | bits,
+                                  frontier=state.frontier | bits)
+
         byz_g = (jax.lax.all_gather(state.byzantine, AXIS, tiled=True)
                  if self.mode in ("pull", "pushpull") else None)
         state, deliveries = self._gossip_local(
@@ -274,7 +316,16 @@ class ShardedSimulator:
         per_msg = jax.lax.psum(
             jnp.sum(state.seen & ok[:, None], axis=0, dtype=jnp.int32),
             AXIS) / denom
-        coverage = jnp.mean(per_msg[:self._n_honest])
+        if self.message_stagger > 0:
+            # mean over the columns GENERATED so far (coverage_of has
+            # the rationale); cross-shard "any bit" rides a psum
+            col_any = jax.lax.psum(
+                jnp.any(state.seen[:, :self._n_honest], axis=0)
+                .astype(jnp.int32), AXIS) > 0
+            n_gen = jnp.maximum(jnp.sum(col_any, dtype=jnp.int32), 1)
+            coverage = jnp.sum(per_msg[:self._n_honest]) / n_gen
+        else:
+            coverage = jnp.mean(per_msg[:self._n_honest])
 
         metrics = {
             "coverage": coverage,
@@ -350,10 +401,16 @@ class ShardedSimulator:
             st_spec, tp_spec, _ = self._specs()
             from jax.sharding import PartitionSpec as P
 
+            from p2p_gossipprotocol_tpu.state import stagger_sched_end
+
+            sched_end = stagger_sched_end(self._n_honest,
+                                          self.message_stagger)
+
             def looped(st, tp):
                 def cond(carry):
                     st, tp, cov = carry
-                    return (cov < target) & (st.round < max_rounds)
+                    return (((cov < target) | (st.round < sched_end))
+                            & (st.round < max_rounds))
 
                 def body(carry):
                     st, tp, _ = carry

@@ -32,16 +32,30 @@ from p2p_gossipprotocol_tpu.state import (GossipState, SIRState,
                                           init_gossip_state, init_sir_state)
 
 
-def coverage_of(state: GossipState, n_honest: int | None = None
-                ) -> jax.Array:
+def coverage_of(state: GossipState, n_honest: int | None = None,
+                stagger: int = 0) -> jax.Array:
     """Mean over (honest) message columns of the fraction of live honest
-    peers that have seen the message."""
+    peers that have seen the message.
+
+    With staggered generation (``stagger=k>0``) the mean runs over the
+    columns GENERATED so far — a rumor that doesn't exist yet (or whose
+    source died before its activation round, so it never will) can't
+    count against coverage, exactly as the reference's
+    coverage-of-existing-messages would read.  Generated is derived
+    from the seen matrix itself: an injected column holds its source
+    bit forever (seen bits never clear), a never-injected one holds
+    nothing."""
     ok = state.alive & ~state.byzantine
     denom = jnp.maximum(jnp.sum(ok, dtype=jnp.int32), 1)
     per_msg = jnp.sum(state.seen & ok[:, None], axis=0,
                       dtype=jnp.int32) / denom
-    if n_honest is not None and n_honest < state.n_msgs:
-        per_msg = per_msg[:n_honest]
+    n_h = state.n_msgs if n_honest is None else n_honest
+    if n_h < state.n_msgs:
+        per_msg = per_msg[:n_h]
+    if stagger > 0:
+        n_gen = jnp.sum(jnp.any(state.seen[:, :n_h], axis=0),
+                        dtype=jnp.int32)
+        return jnp.sum(per_msg) / jnp.maximum(n_gen, 1)
     return jnp.mean(per_msg)
 
 
@@ -103,6 +117,10 @@ class Simulator:
     n_honest_msgs: int | None = None   # None → all columns honest
     max_strikes: int = 3
     rewire: bool = True
+    #: rounds between successive message activations: column m enters at
+    #: its source in round m*k (messageGenerationLoop cadence,
+    #: peer.cpp:357-377).  0 = every rumor exists from round 0.
+    message_stagger: int = 0
     seed: int = 0
     transport: object | None = None   # Transport; None → JaxTransport
 
@@ -124,14 +142,51 @@ class Simulator:
 
         self._scan_jit = jax.jit(_scan, static_argnums=2)
         self._loop_cache: dict = {}   # (target, max_rounds) -> compiled
+        if self.message_stagger > 0:
+            self._message_plan()   # eager: a traced cache would leak
 
     # ------------------------------------------------------------------
     def init_state(self, sources=None) -> GossipState:
+        if sources is not None and self.message_stagger > 0:
+            raise ValueError(
+                "custom sources are incompatible with message_stagger "
+                "(staggered generation re-derives the default placement "
+                "each round)")
         key = jax.random.PRNGKey(self.seed)
         return init_gossip_state(self.topo, self.n_msgs, key,
                                  sources=sources,
                                  byzantine_fraction=self.byzantine_fraction,
-                                 n_honest_msgs=self._n_honest)
+                                 n_honest_msgs=self._n_honest,
+                                 stagger=self.message_stagger)
+
+    def _message_plan(self) -> jax.Array:
+        """Per-column source peers (state.message_plan), cached eagerly
+        so the per-round generation gate costs O(n_msgs), not a fresh
+        O(n_peers) placement every round."""
+        if getattr(self, "_plan_cache", None) is None:
+            from p2p_gossipprotocol_tpu.state import message_plan
+
+            self._plan_cache = message_plan(
+                self.seed, self.topo.n_peers, self.byzantine_fraction,
+                self.n_msgs, self._n_honest)
+        return self._plan_cache
+
+    def _generate_messages(self, state: GossipState) -> GossipState:
+        """Staggered generation: on round ``m * k`` inject column m's
+        bit at its source peer (the vectorized messageGenerationLoop
+        tick, peer.cpp:357-377).  Runs after churn, so a source that
+        died before its activation round never generates — like the
+        reference's generation thread stopping with its process.  The
+        injected frontier bit is relayed THIS round, matching how the
+        round-0 seeding is consumed by the first step."""
+        k = self.message_stagger
+        sources = self._message_plan()
+        col = jnp.arange(self.n_msgs, dtype=jnp.int32)
+        gen = ((col * k == state.round) & (col < self._n_honest)
+               & state.alive[sources] & ~state.byzantine[sources])
+        bits = jnp.zeros_like(state.seen).at[sources, col].max(gen)
+        return state.replace(seen=state.seen | bits,
+                             frontier=state.frontier | bits)
 
     # ------------------------------------------------------------------
     def step(self, state: GossipState, topo: Topology
@@ -147,9 +202,12 @@ class Simulator:
         state = state.replace(edge_strikes=strikes)
         if self._n_honest < self.n_msgs:
             state = inject_byzantine(state, self._n_honest)
+        if self.message_stagger > 0:
+            state = self._generate_messages(state)
         state, deliveries = self._round_fn(state, topo)
         metrics = {
-            "coverage": coverage_of(state, self._n_honest),
+            "coverage": coverage_of(state, self._n_honest,
+                                    stagger=self.message_stagger),
             "deliveries": deliveries,
             "frontier_size": jnp.sum(state.frontier, dtype=jnp.int32),
             "live_peers": jnp.sum(state.alive, dtype=jnp.int32),
@@ -189,9 +247,15 @@ class Simulator:
 
         cache_key = (target, max_rounds)
         if cache_key not in self._loop_cache:
+            from p2p_gossipprotocol_tpu.state import stagger_sched_end
+
+            sched_end = stagger_sched_end(self._n_honest,
+                                          self.message_stagger)
+
             def cond(carry):
                 st, tp, cov = carry
-                return (cov < target) & (st.round < max_rounds)
+                return (((cov < target) | (st.round < sched_end))
+                        & (st.round < max_rounds))
 
             def body(carry):
                 st, tp, _ = carry
@@ -240,6 +304,7 @@ class Simulator:
             byzantine_fraction=cfg.byzantine_fraction,
             n_honest_msgs=n_msgs if n_junk else None,
             max_strikes=cfg.max_missed_pings,
+            message_stagger=cfg.message_stagger,
             seed=cfg.prng_seed,
         )
 

@@ -50,20 +50,64 @@ class GossipState:
         return self.seen.shape[1]
 
 
+def message_sources(byz: jax.Array, n_msgs: int,
+                    n_honest: int) -> jax.Array:
+    """Source peer of each message column: rumors spread evenly over the
+    HONEST peer population — the analogue of every reference peer
+    generating its own messages (messageGenerationLoop, peer.cpp:357-379).
+    Honest rumors must originate at honest peers (a byzantine source
+    never relays, so its rumor would be stillborn — not the scenario the
+    Byzantine config measures).  Deterministic in ``byz``, so the
+    staggered-generation path (Simulator.step) recomputes the SAME
+    placement init_gossip_state used."""
+    n = byz.shape[0]
+    honest_idx = jnp.nonzero(~byz, size=n, fill_value=0)[0]
+    n_honest_peers = jnp.maximum(jnp.sum(~byz, dtype=jnp.int32), 1)
+    stride = jnp.maximum(n_honest_peers // max(n_honest, 1), 1)
+    pos = (jnp.arange(n_msgs, dtype=jnp.int32) * stride) % n_honest_peers
+    return honest_idx[pos]
+
+
+def message_plan(seed: int, n_peers: int, byzantine_fraction: float,
+                 n_msgs: int, n_honest: int) -> jax.Array:
+    """Per-column source peers from the SAME seed splits and byzantine
+    draw init_gossip_state makes — the one derivation behind both the
+    single-chip and sharded engines' staggered injection, so their
+    placements cannot desynchronize."""
+    key = jax.random.PRNGKey(seed)
+    _, k_byz, _ = jax.random.split(key, 3)
+    if byzantine_fraction > 0.0:
+        byz = jax.random.uniform(k_byz, (n_peers,)) < byzantine_fraction
+    else:
+        byz = jnp.zeros(n_peers, bool)
+    return message_sources(byz, n_msgs, n_honest)
+
+
+def stagger_sched_end(n_honest: int, stagger: int) -> int:
+    """First round index by which EVERY scheduled column has activated
+    (0 when staggering is off).  run_to_coverage loops must not stop
+    before this: coverage over the generated-so-far columns can hit the
+    target while most of the schedule is still pending (column 0
+    saturates before column 1 exists)."""
+    return (n_honest - 1) * stagger + 1 if stagger > 0 else 0
+
+
 def init_gossip_state(topo: Topology, n_msgs: int, key: jax.Array,
                       sources: jax.Array | None = None,
                       byzantine_fraction: float = 0.0,
-                      n_honest_msgs: int | None = None) -> GossipState:
-    """Fresh state: message j originates at peer ``sources[j]``.
+                      n_honest_msgs: int | None = None,
+                      stagger: int = 0) -> GossipState:
+    """Fresh state: message j originates at peer ``sources[j]``
+    (placement: :func:`message_sources`); columns ≥ ``n_honest_msgs``
+    are the adversary's injection budget and start empty.
 
-    Default source placement spreads rumors evenly over the HONEST peer
-    population — the analogue of every reference peer generating its own
-    messages (messageGenerationLoop, peer.cpp:357-379) with the message
-    count bounded like the reference's 10-message cap (peer.cpp:358).
-    Honest rumors must originate at honest peers (a byzantine source never
-    relays, so its rumor would be stillborn — not the scenario the
-    Byzantine config measures).  Columns ≥ ``n_honest_msgs`` are the
-    adversary's injection budget and start empty.
+    ``stagger=0`` (default): every rumor exists from round 0 — the
+    batch analogue of the reference's bounded message count
+    (peer.cpp:358).  ``stagger=k>0``: NO columns are seeded here;
+    column m activates at round ``m*k`` (injected by the engines'
+    round step), matching messageGenerationLoop's cadence of one
+    message per message_interval (peer.cpp:357-377) — with one round
+    ≈ one message_interval tick, k=1 is the faithful timeline.
     """
     n = topo.n_peers
     k_src, k_byz, k_run = jax.random.split(key, 3)
@@ -73,13 +117,9 @@ def init_gossip_state(topo: Topology, n_msgs: int, key: jax.Array,
     else:
         byz = jnp.zeros(n, bool)
     if sources is None:
-        honest_idx = jnp.nonzero(~byz, size=n, fill_value=0)[0]
-        n_honest_peers = jnp.maximum(jnp.sum(~byz, dtype=jnp.int32), 1)
-        stride = jnp.maximum(n_honest_peers // max(n_honest, 1), 1)
-        pos = (jnp.arange(n_msgs, dtype=jnp.int32) * stride) % n_honest_peers
-        sources = honest_idx[pos]
+        sources = message_sources(byz, n_msgs, n_honest)
     col = jnp.arange(n_msgs)
-    place = col < n_honest
+    place = (col < n_honest) & (stagger <= 0)
     seen = jnp.zeros((n, n_msgs), bool).at[
         jnp.where(place, sources, 0), col].max(place)
     return GossipState(
