@@ -1,0 +1,171 @@
+"""Round-5 TPU measurements: direct microbenches of the traffic model's
+two contested terms (round-4 verdict weak #2 / missing #3), plus the
+staggered-generation A/B.
+
+1. PREP term: the model charges ``3 x W x plane`` per pass for the
+   XLA-side mask + row-permute gather (aligned.py:hbm_bytes_per_round).
+   Here the prep op (``take(frontier & alive & ~byz, perm)``) is timed
+   ALONE, jitted, so its real bytes/s can be compared against the
+   charge — no profiler parsing needed.
+2. ROLL-GROUP reuse: the model assumes consecutive slots sharing a
+   block roll are served from the resident VMEM buffer instead of
+   re-DMAing (build_aligned roll_groups).  The gossip kernel is timed
+   ALONE at the same shapes with one roll per slot vs 4 distinct
+   rolls: if the pipeline reuse is real, kernel time scales with the
+   DISTINCT-roll count, not the slot count.
+3. STAGGER A/B at 1M x 32: per-round cost of the generation injection
+   (one dynamic single-element update per round) and the
+   rounds-to-coverage dynamics with the reference's cadence vs
+   all-at-round-0.
+
+Run on the chip:
+    PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/measure_round5.py
+Appends one JSON row per measurement to GOSSIP_R5_OUT (default
+benchmarks/results/round5_tpu.jsonl).
+
+NOT measurable this round: the 1-D vs 2-D mesh A/B (verdict item 8)
+needs >= 2 physical devices; the tunnel exposes ONE chip.  Recorded as
+blocked in BASELINE.md rather than simulated on virtual CPU devices,
+whose memory system would say nothing about HBM.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+OUT = os.environ.get(
+    "GOSSIP_R5_OUT",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "results", "round5_tpu.jsonl"))
+LANES = 128
+
+
+def emit(row):
+    row["device"] = str(jax.devices()[0]).replace(" ", "_")
+    row["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    print(json.dumps(row), flush=True)
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def _time(fn, *args, iters=20):
+    out = fn(*args)
+    jax.block_until_ready(out)        # compile + upload excluded
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_prep_term(n=1 << 20):
+    """The per-pass XLA prep in isolation, W = 1/4/8 planes."""
+    from p2p_gossipprotocol_tpu.aligned import build_aligned
+
+    topo = build_aligned(seed=0, n=n, n_slots=16, degree_law="powerlaw",
+                         roll_groups=4)
+    R = topo.rows
+    key = jax.random.PRNGKey(0)
+    alive_w = jnp.full((R, LANES), -1, jnp.int32)
+
+    for W in (1, 4, 8):
+        frontier = jax.random.randint(key, (W, R, LANES),
+                                      jnp.iinfo(jnp.int32).min,
+                                      jnp.iinfo(jnp.int32).max, jnp.int32)
+
+        @jax.jit
+        def prep(f, a):
+            return jnp.take(f & a[None], topo.perm, axis=1)
+
+        dt = _time(prep, frontier, alive_w)
+        plane = R * LANES * 4
+        moved = 2 * W * plane            # read src + write dst (minimum)
+        charged = 3 * W * plane          # the model's charge
+        emit({"config": f"prep_term_w{W}", "n_peers": n, "W": W,
+              "ms": round(dt * 1e3, 3),
+              "min_bytes": moved, "model_bytes": charged,
+              "achieved_gb_s_vs_min": round(moved / dt / 1e9, 1),
+              "achieved_gb_s_vs_model": round(charged / dt / 1e9, 1)})
+
+
+def bench_roll_group_reuse(n=1 << 20):
+    """gossip_pass alone: 16 distinct rolls vs 4 — if the pallas
+    pipeline really serves same-roll slots from the resident buffer,
+    time tracks the distinct-roll count."""
+    from p2p_gossipprotocol_tpu.aligned import build_aligned
+    from p2p_gossipprotocol_tpu.ops.aligned_kernel import gossip_pass
+
+    key = jax.random.PRNGKey(1)
+    times = {}
+    for groups in (None, 4, 2):
+        topo = build_aligned(seed=0, n=n, n_slots=16,
+                             degree_law="powerlaw", roll_groups=groups)
+        R = topo.rows
+        y = jax.random.randint(key, (1, R, LANES),
+                               jnp.iinfo(jnp.int32).min,
+                               jnp.iinfo(jnp.int32).max, jnp.int32)
+
+        @jax.jit
+        def pass_only(y):
+            return gossip_pass(y, topo.colidx, topo.deg, topo.rolls,
+                               topo.subrolls, pull=False,
+                               rowblk=topo.rowblk)
+
+        dt = _time(pass_only, y)
+        label = groups or 16
+        times[label] = dt
+        emit({"config": f"kernel_only_rolls_{label}", "n_peers": n,
+              "distinct_rolls": int(label), "ms": round(dt * 1e3, 3)})
+    if 16 in times and 4 in times and times[4] > 0:
+        emit({"config": "roll_reuse_speedup_16_over_4",
+              "value": round(times[16] / times[4], 2),
+              "expect_if_reuse_real": "~2-4x",
+              "expect_if_no_reuse": "~1x"})
+
+
+def bench_stagger_ab(n=1 << 20):
+    from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator,
+                                                aligned_coverage,
+                                                build_aligned)
+    from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+
+    topo = build_aligned(seed=7, n=n, n_slots=16, degree_law="powerlaw",
+                         roll_groups=4)
+    for stagger in (0, 1):
+        sim = AlignedSimulator(
+            topo=topo, n_msgs=32, mode="pushpull",
+            churn=ChurnConfig(rate=0.05, kill_round=1), max_strikes=3,
+            liveness_every=3, message_stagger=stagger, seed=1)
+        state, topo2, rounds, wall = sim.run_to_coverage(
+            target=0.99, max_rounds=256)
+        cov = aligned_coverage(sim, state, topo2)
+        emit({"config": f"1m_32msg_stagger_{stagger}", "n_peers": n,
+              "n_msgs": 32, "message_stagger": stagger,
+              "rounds": rounds, "wall_s": round(wall, 4),
+              "ms_per_round": round(wall / max(rounds, 1) * 1000, 3),
+              "final_coverage": round(cov, 5)})
+
+
+def main():
+    backend = jax.default_backend()
+    emit({"config": "_backend", "backend": backend})
+    if backend not in ("tpu", "axon"):
+        print("not on TPU — round-5 microbenches need the chip",
+              file=sys.stderr)
+        return 2
+    bench_prep_term()
+    bench_roll_group_reuse()
+    bench_stagger_ab()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

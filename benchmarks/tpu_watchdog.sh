@@ -31,6 +31,8 @@ while true; do
     say "bench exit=$?"
     timeout -k 30 7200 python benchmarks/measure_round4.py >>"$LOG" 2>&1
     say "measure_round4 exit=$?"
+    timeout -k 30 3600 python benchmarks/measure_round5.py >>"$LOG" 2>&1
+    say "measure_round5 exit=$?"
     say "measurement chain done"
     exit 0
   fi

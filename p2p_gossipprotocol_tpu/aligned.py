@@ -533,13 +533,11 @@ class AlignedSimulator:
                      < self.byzantine_fraction) & valid_b
         else:
             byz_b = jnp.zeros((rows, LANES), bool)
+        from p2p_gossipprotocol_tpu.state import sources_from_mask
+
         ok_flat = (valid_b & ~byz_b).reshape(-1)
-        honest_idx = jnp.nonzero(ok_flat, size=rows * LANES,
-                                 fill_value=0)[0]
-        n_ok = jnp.maximum(jnp.sum(ok_flat, dtype=jnp.int32), 1)
-        stride = jnp.maximum(n_ok // max(self._n_honest, 1), 1)
-        pos = (jnp.arange(self.n_msgs, dtype=jnp.int32) * stride) % n_ok
-        self._plan_cache = (byz_b, honest_idx[pos])
+        self._plan_cache = (byz_b, sources_from_mask(
+            ok_flat, self.n_msgs, self._n_honest))
         return self._plan_cache
 
     def init_state(self) -> AlignedState:

@@ -39,10 +39,11 @@ _send_error_types = None
 # #5: ``have`` carried every hash ever seen, O(history) bytes per
 # interval per peer forever).  Instead the requester sends a fixed-size
 # salted Bloom filter of its hash set: 1 KiB regardless of history.  A
-# false positive (~0.02% at 1k messages) suppresses a message for ONE
-# interval only — the salt is fresh per request, so the same pair
-# re-tests under new bit positions next time and delivery stays
-# eventual with probability 1.
+# false positive — (1-e^(-4n/8192))^4 ≈ 0.007% at the reference-scale
+# 200 messages, ~2% at 1k — suppresses a message for ONE interval only:
+# the salt is fresh per request, so the same pair re-tests under new
+# bit positions next time and delivery stays eventual with
+# probability 1.
 BLOOM_BITS = 8192
 BLOOM_HASHES = 4
 # Histories this small also carry the legacy ``have`` hash list in the

@@ -50,6 +50,21 @@ class GossipState:
         return self.seen.shape[1]
 
 
+def sources_from_mask(ok_flat: jax.Array, n_msgs: int,
+                      n_honest: int) -> jax.Array:
+    """THE source-placement rule, shared by every engine: spread the
+    message columns evenly (stride + modulo) over the positions where
+    ``ok_flat`` is True, returning flat indices into that mask's space.
+    One implementation so the edges and aligned engines' placements
+    cannot desynchronize."""
+    n = ok_flat.shape[0]
+    ok_idx = jnp.nonzero(ok_flat, size=n, fill_value=0)[0]
+    n_ok = jnp.maximum(jnp.sum(ok_flat, dtype=jnp.int32), 1)
+    stride = jnp.maximum(n_ok // max(n_honest, 1), 1)
+    pos = (jnp.arange(n_msgs, dtype=jnp.int32) * stride) % n_ok
+    return ok_idx[pos]
+
+
 def message_sources(byz: jax.Array, n_msgs: int,
                     n_honest: int) -> jax.Array:
     """Source peer of each message column: rumors spread evenly over the
@@ -60,12 +75,7 @@ def message_sources(byz: jax.Array, n_msgs: int,
     Byzantine config measures).  Deterministic in ``byz``, so the
     staggered-generation path (Simulator.step) recomputes the SAME
     placement init_gossip_state used."""
-    n = byz.shape[0]
-    honest_idx = jnp.nonzero(~byz, size=n, fill_value=0)[0]
-    n_honest_peers = jnp.maximum(jnp.sum(~byz, dtype=jnp.int32), 1)
-    stride = jnp.maximum(n_honest_peers // max(n_honest, 1), 1)
-    pos = (jnp.arange(n_msgs, dtype=jnp.int32) * stride) % n_honest_peers
-    return honest_idx[pos]
+    return sources_from_mask(~byz, n_msgs, n_honest)
 
 
 def message_plan(seed: int, n_peers: int, byzantine_fraction: float,
