@@ -3,11 +3,14 @@
 # with a hard-kill timeout (jax.devices() HANGS in C when the tunnel is
 # down — a plain timeout won't kill it); the moment a probe succeeds,
 # run the measurement chain:
-#   1. benchmarks/mosaic_smoke.py  — Mosaic compile gate, every kernel
+#   1. benchmarks/mosaic_smoke.py   — Mosaic compile gate, every kernel
 #      variant, bitwise vs interpret
-#   2. bench.py                    — the driver's headline metric
+#   2. bench.py                     — the driver's headline metric
 #   3. benchmarks/measure_round4.py — stride/roll-group A/B at 1M,
 #      10M x 256 headline, 10M SIR, profiler trace
+#   4. benchmarks/measure_round5.py — prep-term + roll-reuse
+#      microbenches, stagger A/B
+#   5. benchmarks/run_baselines.py  — the five BASELINE configs
 # Probes every 90 s; everything appends to benchmarks/results/.
 set -u
 cd /root/repo
@@ -33,6 +36,8 @@ while true; do
     say "measure_round4 exit=$?"
     timeout -k 30 3600 python benchmarks/measure_round5.py >>"$LOG" 2>&1
     say "measure_round5 exit=$?"
+    timeout -k 30 7200 python benchmarks/run_baselines.py >>"$LOG" 2>&1
+    say "run_baselines exit=$?"
     say "measurement chain done"
     exit 0
   fi
