@@ -130,6 +130,15 @@ def main():
             byzantine_fraction=0.1, n_honest_msgs=16, seed=1,
             interpret=interp)) and None))
 
+    # 6b) staggered generation: the in-round injection (dynamic
+    #     single-element updates + generated-column census) compiled
+    #     around the same kernels
+    results.append(_check("stagger", lambda: _run_pair(
+        lambda interp: AlignedSimulator(
+            topo=topo, n_msgs=32, mode="pushpull", message_stagger=2,
+            churn=ChurnConfig(rate=0.05, kill_round=1), liveness_every=3,
+            seed=1, interpret=interp), rounds=8) and None))
+
     # 7) SIR count_pass
     def sir_pair():
         def mk(interp):
