@@ -137,9 +137,13 @@ def _bench_aligned(n, n_msgs, degree, mode):
     # Staggered generation: message m enters at round m*k (the
     # reference's messageGenerationLoop cadence); 0 = all at round 0.
     stagger = int(os.environ.get("GOSSIP_BENCH_STAGGER", "0"))
+    # Block-perm overlay (fused kernels, zero per-pass prep) — opt-in
+    # until the on-chip A/B lands.
+    block_perm = bool(int(os.environ.get("GOSSIP_BENCH_BLOCK_PERM", "0")))
     t0 = time.perf_counter()
     topo = build_aligned(seed=0, n=n, n_slots=degree,
-                         degree_law="powerlaw", roll_groups=roll_groups)
+                         degree_law="powerlaw", roll_groups=roll_groups,
+                         block_perm=block_perm)
     graph_s = time.perf_counter() - t0
     sim = AlignedSimulator(topo=topo, n_msgs=n_msgs, mode=mode,
                            churn=ChurnConfig(rate=churn_rate, kill_round=1),
@@ -156,6 +160,7 @@ def _bench_aligned(n, n_msgs, degree, mode):
         "liveness_every": liveness_every,
         "roll_groups": roll_groups,
         **({"message_stagger": stagger} if stagger else {}),
+        **({"block_perm": True} if block_perm else {}),
         # analytic traffic model (aligned.hbm_bytes_per_round) vs the
         # measured wall: how close the engine runs to the ~800 GB/s
         # v5e HBM roof — the round-3 judge's "quantify the gap" ask

@@ -152,6 +152,40 @@ def bench_roll_group_reuse(n=1 << 20):
               "expect_if_no_reuse": "~1x"})
 
 
+def bench_block_perm_ab(n=1 << 20):
+    """Fused (block-perm) vs legacy overlay, full rounds at 1M x 256
+    messages (W=8, where the removed 3W prep term is largest): the
+    direct end-to-end measurement of round-4 verdict item 3.  Target:
+    >= 25% bytes/round (model) showing up as ms/round."""
+    from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator,
+                                                build_aligned)
+    from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+
+    # (block_perm, roll_groups): legacy baseline, fusion alone (model:
+    # -23% bytes), fusion + two rolls (model: -43% — the cuts stack;
+    # one roll is rejected by build_aligned: the block-level overlay
+    # would be a single permutation cycle and dissemination stalls)
+    for bp, groups in ((False, 4), (True, 4), (True, 2)):
+        topo = build_aligned(seed=7, n=n, n_slots=16,
+                             degree_law="powerlaw", roll_groups=groups,
+                             n_msgs=256, block_perm=bp)
+        sim = AlignedSimulator(
+            topo=topo, n_msgs=256, mode="pushpull",
+            churn=ChurnConfig(rate=0.05, kill_round=1), max_strikes=3,
+            liveness_every=3, seed=1)
+        res = sim.run(12, warmup=True)
+        emit({"config": (f"1m_256msg_block_perm_{int(bp)}"
+                         f"_groups_{groups}"),
+              "n_peers": n, "n_msgs": 256, "block_perm": bp,
+              "roll_groups": groups,
+              "wall_s": round(res.wall_s, 4),
+              "ms_per_round": round(res.wall_s / 12 * 1000, 3),
+              "final_coverage": round(float(res.coverage[-1]), 5),
+              "bytes_per_round": sim.hbm_bytes_per_round(),
+              "achieved_gb_s": round(
+                  sim.hbm_bytes_per_round() * 12 / res.wall_s / 1e9, 1)})
+
+
 def bench_stagger_ab(n=1 << 20):
     from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator,
                                                 aligned_coverage,
@@ -186,6 +220,7 @@ def main():
     emit({"config": "_backend", "backend": backend})
     bench_prep_term()
     bench_roll_group_reuse()
+    bench_block_perm_ab()
     bench_stagger_ab()
     return 0
 

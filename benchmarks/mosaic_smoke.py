@@ -139,6 +139,20 @@ def main():
             churn=ChurnConfig(rate=0.05, kill_round=1), liveness_every=3,
             seed=1, interpret=interp), rounds=8) and None))
 
+    # 6c) block-perm fused path: the ytab index-table maps + in-kernel
+    #     src_ok masking (round-5 work — never Mosaic-compiled either)
+    topo_bp = build_aligned(seed=3, n=n, n_slots=8, roll_groups=4,
+                            block_perm=True)
+    results.append(_check("block_perm_fused", lambda: _run_pair(
+        lambda interp: AlignedSimulator(
+            topo=topo_bp, n_msgs=64, mode="pushpull",
+            churn=ChurnConfig(rate=0.05, kill_round=1), max_strikes=2,
+            liveness_every=2, seed=1, interpret=interp)) and None))
+    results.append(_check("block_perm_fanout", lambda: _run_pair(
+        lambda interp: AlignedSimulator(
+            topo=topo_bp, n_msgs=32, mode="push", fanout=2, seed=1,
+            interpret=interp)) and None))
+
     # 7) SIR count_pass
     def sir_pair():
         def mk(interp):

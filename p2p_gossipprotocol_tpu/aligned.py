@@ -116,6 +116,14 @@ class AlignedTopology:
     n_peers: int = struct.field(pytree_node=False)
     n_slots: int = struct.field(pytree_node=False)
     rowblk: int = struct.field(pytree_node=False)
+    #: block-perm overlays only (build_aligned(block_perm=True)):
+    #: int32[D, T] composed y-block table ytab[d, t] =
+    #: pblock[(t + roll_d) % T].  Its presence switches the engines onto
+    #: the FUSED round path — kernels read the raw state planes through
+    #: this table (perm∘roll in the BlockSpec index map) with the send
+    #: mask ANDed in-kernel, so the per-pass host-side permute+mask prep
+    #: (the traffic model's 3W term) does not exist at all.
+    ytab: jax.Array | None = None
 
     @property
     def rows(self) -> int:
@@ -132,7 +140,8 @@ def build_aligned(seed: int, n: int, n_slots: int = 16,
                   powerlaw_alpha: float = 2.5,
                   rowblk: int = 512, n_shards: int = 1,
                   n_msgs: int = 1,
-                  roll_groups: int | None = None) -> AlignedTopology:
+                  roll_groups: int | None = None,
+                  block_perm: bool = False) -> AlignedTopology:
     """Sample an aligned overlay for ``n`` peers with ``n_slots`` in-edge
     slots per peer.
 
@@ -199,15 +208,58 @@ def build_aligned(seed: int, n: int, n_slots: int = 16,
                0) or next(d for d in range(cap, 0, -1) if local % d == 0)
     t_blocks = rows // blk
 
-    perm = rng.permutation(rows).astype(np.int32)
+    if block_perm and roll_groups is not None and roll_groups <= 1 \
+            and n_slots > 1:
+        # With ONE shared block roll the block-level overlay under a
+        # block permutation is a single permutation cycle (out-degree
+        # 1): dissemination stalls at the cycle-reachable fraction
+        # (measured: 25-37% coverage plateau at 262k).  The row-perm
+        # family tolerates roll_groups=1 (rows scramble globally);
+        # block_perm needs block-level mixing.
+        raise ValueError(
+            "block_perm needs >= 2 distinct block rolls "
+            "(roll_groups >= 2, or None for one per slot)")
+    if block_perm:
+        # BLOCK-granular permutation: perm permutes whole row blocks, so
+        # perm∘roll_d is itself a block map and can ride the kernels'
+        # BlockSpec index table (ytab) — the engines then read the raw
+        # state planes with NO host-side permute/mask pass per round.
+        # Marginals are unchanged (pblock uniform over blocks x subroll
+        # uniform over in-block rows x lane uniform over 128 = neighbor
+        # row uniform over all rows); the structural caveat coarsens one
+        # level: peers sharing a BLOCK share their slot-d neighbor
+        # block, so block-level mixing needs >= 2 distinct rolls
+        # (convergence parity asserted in tests/test_block_perm.py).
+        pblock = rng.permutation(t_blocks).astype(np.int32)
+        perm = (pblock[np.arange(rows) // blk] * blk
+                + np.arange(rows) % blk).astype(np.int32)
+    else:
+        pblock = None
+        perm = rng.permutation(rows).astype(np.int32)
     n_groups = (n_slots if roll_groups is None
                 else max(1, min(roll_groups, n_slots)))
-    group_rolls = rng.integers(0, t_blocks, size=n_groups, dtype=np.int32)
+    if block_perm and t_blocks > 1:
+        # Distinctness is load-bearing here: with-replacement draws can
+        # collide (P=1/t_blocks per pair), and if ALL block rolls
+        # coincide the block-level overlay degenerates to the
+        # single-cycle stall the roll_groups<=1 guard above rejects.
+        # Draw from a permutation so the first min(n_groups, t_blocks)
+        # rolls are guaranteed distinct.  (t_blocks == 1 has no block
+        # graph at all — subrolls + lanes do all the mixing.)
+        distinct = rng.permutation(t_blocks).astype(np.int32)
+        group_rolls = distinct[np.arange(n_groups) % t_blocks]
+    else:
+        group_rolls = rng.integers(0, t_blocks, size=n_groups,
+                                   dtype=np.int32)
     rolls = group_rolls[(np.arange(n_slots) * n_groups)
                         // n_slots].astype(np.int32)
     subrolls = rng.integers(0, blk, size=n_slots, dtype=np.int32)
     colidx = rng.integers(0, LANES, size=(n_slots, rows, LANES),
                           dtype=np.int8)
+    ytab = None
+    if block_perm:
+        ytab = pblock[(np.arange(t_blocks)[None, :] + rolls[:, None])
+                      % t_blocks].astype(np.int32)
 
     if degree_law == "regular":
         deg = np.full((rows, LANES), n_slots, np.int8)
@@ -231,6 +283,7 @@ def build_aligned(seed: int, n: int, n_slots: int = 16,
         deg=jnp.asarray(deg),
         valid_w=jnp.asarray(np.where(valid, -1, 0).astype(np.int32)),
         n_peers=n, n_slots=n_slots, rowblk=blk,
+        ytab=None if ytab is None else jnp.asarray(ytab),
     )
 
 
@@ -445,7 +498,8 @@ class AlignedSimulator:
                              degree_law=law,
                              powerlaw_alpha=cfg.powerlaw_alpha,
                              n_shards=n_shards, n_msgs=n_msgs,
-                             roll_groups=cfg.roll_groups or None)
+                             roll_groups=cfg.roll_groups or None,
+                             block_perm=bool(cfg.block_perm))
         return cls(topo=topo, n_msgs=n_msgs, mode=cfg.mode,
                    fanout=cfg.fanout,
                    churn=ChurnConfig(rate=cfg.churn_rate),
@@ -494,11 +548,20 @@ class AlignedSimulator:
         rolls = np.asarray(self.topo.rolls)
         y_streams = int(1 + (np.diff(rolls) != 0).sum()) if D > 1 else 1
 
+        fused = self.topo.ytab is not None
         gossip_pass_bytes = (y_streams * word_planes  # y per distinct roll
                              + slot8              # colidx
                              + R * LANES          # gate
                              + word_planes)       # OR-accumulator out
-        prep = 3 * word_planes                    # mask + permute gather
+        if fused:
+            # block-perm overlay: NO host-side permute/mask pass — the
+            # kernel reads raw state planes through the ytab index
+            # table; the cost is the src_ok mask plane streamed per
+            # distinct roll instead
+            prep = 0
+            gossip_pass_bytes += y_streams * plane
+        else:
+            prep = 3 * word_planes                # mask + permute gather
         n_passes = 2 if self.mode == "pushpull" else 1
         total = n_passes * (gossip_pass_bytes + prep)
         if self.fanout > 0:
@@ -507,7 +570,7 @@ class AlignedSimulator:
             liveness = (y_streams * plane         # alive plane per roll
                         + 4 * slot8               # colidx/strikes r+w
                         + 2 * slot8               # evict8 write + reduce
-                        + 3 * plane)              # gather/prep
+                        + (plane if fused else 3 * plane))  # gather/prep
             total += liveness // self.liveness_every
         total += 4 * word_planes                  # seen|new update + metrics
         return int(total)
@@ -729,6 +792,17 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
     def prow(x):   # apply the row permutation on the rows (ndim-2) axis
         return jnp.take(x, topo.perm, axis=x.ndim - 2)
 
+    # Block-perm overlays (topo.ytab) run the FUSED path: kernels read
+    # the raw state planes through the perm∘roll index table with the
+    # send mask ANDed in-kernel — prow and the host-side masking above
+    # the kernels disappear entirely (the traffic model's 3W prep term).
+    fused = topo.ytab is not None
+    if fused:
+        T_local = state.seen_w.shape[1] // topo.rowblk
+        ytab_local = jax.lax.dynamic_slice(
+            topo.ytab, (jnp.int32(0), jnp.int32(t_off)),
+            (topo.ytab.shape[0], T_local))
+
     valid_b = topo.valid_w != 0
     # k_rew is retired (rewire candidates are hashed in-kernel) but the
     # 5-way split is kept so the round's key schedule — and with it every
@@ -756,11 +830,14 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
 
         def lv_run(ops):
             col, stk = ops
-            y_alive = prow(gather(alive_w))
+            y_alive = (gather(alive_w) if fused
+                       else prow(gather(alive_w)))
             col2, stk2, evict8 = liveness_pass(
                 y_alive, col, stk, topo.deg, rolls_off, topo.subrolls,
                 gbase=grows[::blk], round_idx=state.round,
-                hash_seed=sim.seed, max_strikes=sim.max_strikes,
+                hash_seed=sim.seed,
+                ytab=ytab_local if fused else None,
+                max_strikes=sim.max_strikes,
                 rowblk=topo.rowblk, interpret=sim.interpret)
             return col2, stk2, jnp.sum(evict8, dtype=jnp.int32)
 
@@ -824,11 +901,18 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
             jax.lax.dynamic_slice(frontier_w, cell, (1, 1, 1)) | bit,
             cell)
 
+    if fused:
+        # the in-kernel send mask: -1 where the source is alive and
+        # honest (dead peers don't send; byzantine peers never relay)
+        src_ok = gather(alive_w & ~state.byz_w)
     if sim.mode in ("push", "pushpull"):
         # Dead peers don't send; byzantine peers never relay (suppression,
         # models/gossip.py:50-58) — both masked at the source words.
-        send = frontier_w & alive_w[None] & ~state.byz_w[None]
-        y = prow(gather(send))
+        if fused:
+            y = gather(frontier_w)
+        else:
+            send = frontier_w & alive_w[None] & ~state.byz_w[None]
+            y = prow(gather(send))
         if sim.fanout > 0:
             # Rumor mongering: each peer listens on a random fanout-slot
             # window this round (shard-invariant per-row draw, same
@@ -840,7 +924,10 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
             shift = None
         recv = gossip_pass(y, topo.colidx, topo.deg, rolls_off,
                            topo.subrolls, pull=False, fanout=sim.fanout,
-                           shift=shift, rowblk=topo.rowblk,
+                           shift=shift,
+                           ytab=ytab_local if fused else None,
+                           src_ok=src_ok if fused else None,
+                           rowblk=topo.rowblk,
                            interpret=sim.interpret)
     else:                       # pure anti-entropy pull
         recv = jnp.zeros_like(seen_w)
@@ -848,7 +935,11 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
         # Anti-entropy: each peer pulls one random slot's neighbor's
         # full seen-set; dead/byzantine neighbors serve nothing
         # (gossip.py pull_round's alive[nbr] & ~byzantine[nbr]).
-        ys = prow(gather(state.seen_w & alive_w[None] & ~state.byz_w[None]))
+        if fused:
+            ys = gather(state.seen_w)
+        else:
+            ys = prow(gather(
+                state.seen_w & alive_w[None] & ~state.byz_w[None]))
         u = row_randint(k_pull, grows, (LANES,), 0, 1 << 30, jnp.int32)
         deg32 = topo.deg.astype(jnp.int32)
         delta = (u % jnp.maximum(deg32, 1)).astype(jnp.int8)
@@ -856,6 +947,8 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
                           jnp.int8(topo.n_slots))      # no contact
         recv = recv | gossip_pass(ys, topo.colidx, delta, rolls_off,
                                   topo.subrolls, pull=True,
+                                  ytab=ytab_local if fused else None,
+                                  src_ok=src_ok if fused else None,
                                   rowblk=topo.rowblk,
                                   interpret=sim.interpret)
 

@@ -104,6 +104,10 @@ _SIM_INT_KEYS = {
     # slot); small values let the kernels reuse resident y blocks
     # across slots (build_aligned docstring).
     "roll_groups": "roll_groups",
+    # aligned engine: 1 = block-granular permutation overlay — perm∘roll
+    # rides the kernels' index table, eliminating the per-pass
+    # permute/mask prep entirely (build_aligned(block_perm=True)).
+    "block_perm": "block_perm",
     "rounds": "rounds",
     "prng_seed": "prng_seed",
     # jax backend: rounds between successive message activations —
@@ -174,6 +178,7 @@ class NetworkConfig:
         self.er_p = 0.0
         self.fanout = 0
         self.roll_groups = 0           # aligned engine; 0 = per-slot rolls
+        self.block_perm = 0            # aligned engine; 1 = fused overlay
         self.rounds = 0
         self.message_stagger = 0       # 0 = all rumors at round 0
         self.mesh_devices = 0          # 0/1 = single device
@@ -300,7 +305,7 @@ class NetworkConfig:
         if not is_valid_port(self.local_port):
             raise ConfigError(f"Invalid local_port: {self.local_port}")
         for k in ("n_peers", "n_messages", "avg_degree", "ba_m", "fanout",
-                  "roll_groups", "rounds", "prng_seed",
+                  "roll_groups", "block_perm", "rounds", "prng_seed",
                   "anti_entropy_interval", "message_stagger",
                   "mesh_devices", "msg_shards"):
             if getattr(self, k) < 0:

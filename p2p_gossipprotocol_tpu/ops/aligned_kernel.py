@@ -51,14 +51,24 @@ from jax.experimental.pallas import tpu as pltpu
 LANES = 128
 
 
-def _pass_kernel(pull: bool, n_planes: int, fanout: int, rolls_ref,
-                 subrolls_ref, y_ref, col_ref, gate_ref, *rest):
+def _pass_kernel(pull: bool, n_planes: int, fanout: int, masked: bool,
+                 n_pref: int, *refs):
+    pref, rest = refs[:n_pref], refs[n_pref:]
+    subrolls_ref = pref[1]        # pref[0]=rolls, pref[2]=ytab (fused)
+    y_ref, col_ref, gate_ref = rest[0], rest[1], rest[2]
+    i = 3
+    if masked:
+        # Fused source masking (block-perm overlays): the send words are
+        # the RAW state planes; alive & ~byz of the SOURCE peer is ANDed
+        # in here, per gathered lane, instead of a host-side prep pass.
+        ok_ref = rest[i]
+        i += 1
     # The shift plane exists only in bounded-fanout mode — flood and pull
     # runs must not stream a dead int8 block through every grid step.
     if fanout > 0 and not pull:
-        shift_ref, acc_ref = rest
-    else:
-        (acc_ref,) = rest
+        shift_ref = rest[i]
+        i += 1
+    acc_ref = rest[i]
     d = pl.program_id(1)
     # Per-slot sublane roll: out-row i reads y-row (i + s_d) % blk, so a
     # peer's D slots see D distinct source rows even when the grid has a
@@ -80,11 +90,18 @@ def _pass_kernel(pull: bool, n_planes: int, fanout: int, rolls_ref,
         mask = (d < g) & (jnp.remainder(d - s, jnp.maximum(g, 1)) < fanout)
     else:
         mask = d < g
-    # Static unroll over message planes: col/gate stay resident, each
+    if masked:
+        okv = jnp.take_along_axis(
+            pltpu.roll(ok_ref[:], blk - subrolls_ref[d], axis=0),
+            col, axis=1)
+    # Static unroll over message planes: col/gate/ok stay resident, each
     # plane costs one sublane roll + one lane-wise dynamic_gather.
     for w in range(n_planes):
         y = pltpu.roll(y_ref[w], blk - subrolls_ref[d], axis=0)
-        z = jnp.where(mask, jnp.take_along_axis(y, col, axis=1), 0)
+        zw = jnp.take_along_axis(y, col, axis=1)
+        if masked:
+            zw = zw & okv
+        z = jnp.where(mask, zw, 0)
 
         @pl.when(d == 0)
         def _(w=w, z=z):
@@ -98,21 +115,36 @@ def _pass_kernel(pull: bool, n_planes: int, fanout: int, rolls_ref,
 def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
                 rolls: jax.Array, subrolls: jax.Array, *,
                 pull: bool = False, fanout: int = 0,
-                shift: jax.Array | None = None, rowblk: int = 512,
+                shift: jax.Array | None = None,
+                ytab: jax.Array | None = None,
+                src_ok: jax.Array | None = None, rowblk: int = 512,
                 interpret: bool = False) -> jax.Array:
     """One OR-accumulated D-slot pass over W message planes.
 
-    ``y``       int32[W, Ry, 128] — row-permuted packed sender words.  May
-                                 cover MORE rows than the output (the
-                                 sharded engine passes the full network's
-                                 words while computing only its own row
-                                 blocks; ``rolls`` then carries the
-                                 shard's block offset)
+    ``y``       int32[W, Ry, 128] — packed sender words.  Legacy layout:
+                                 row-permuted AND send-masked on the
+                                 host.  Fused layout (``ytab`` given):
+                                 the RAW state planes — the permutation
+                                 rides the index table and the send
+                                 mask rides ``src_ok``.  May cover MORE
+                                 rows than the output (the sharded
+                                 engine passes the full network's words
+                                 while computing only its own row
+                                 blocks; ``rolls``/``ytab`` then carry
+                                 the shard's block offset)
     ``colidx``  int8 [D, R, 128] — per-slot lane choices (R = output rows)
     ``gate``    int8 [R, 128]  — degree (push) / sampled slot (pull)
     ``rolls``   int32[D]       — per-slot block-roll offsets (scalar
                                  prefetch; drives the y index map)
     ``subrolls`` int32[D]      — per-slot sublane roll within the block
+    ``ytab``    int32[D, T]    — OPTIONAL composed y-block index table
+                                 (block-perm overlays): output block t,
+                                 slot d reads y block ytab[d, t] —
+                                 perm∘roll folded into the BlockSpec, so
+                                 no host-side permute pass exists
+    ``src_ok``  int32[Ry, 128] — with ``ytab``: the source-peer send
+                                 mask (-1 alive&honest / 0), ANDed
+                                 in-kernel per gathered lane
     ``fanout``/``shift`` — bounded fanout (push only): listen on the
                 fanout-slot circular window starting at ``shift`` (int8
                 [R, 128], per-round random in [0, deg)); fanout=0 floods
@@ -126,30 +158,50 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
     T = R // blk          # output (local) row blocks
     Ty = Ry // blk        # y (possibly global) row blocks
     fanout = 0 if pull else fanout
+    fused = ytab is not None
+    if fused:
+        assert src_ok is not None, "block-perm pass needs the src_ok mask"
+        assert ytab.shape == (D, T), (ytab.shape, (D, T))
+        n_pref = 3
+        prefetch = (rolls, subrolls, ytab)
+        y_map = lambda t, d, k, s, yt: (0, yt[d, t], 0)
+        tab_map = lambda t, d, k, s, yt: (d, t, 0)
+        row_map = lambda t, d, k, s, yt: (t, 0)
+        ok_map = lambda t, d, k, s, yt: (yt[d, t], 0)
+    else:
+        n_pref = 2
+        prefetch = (rolls, subrolls)
+        y_map = lambda t, d, k, s: (0, (t + k[d]) % Ty, 0)
+        tab_map = lambda t, d, k, s: (d, t, 0)
+        row_map = lambda t, d, k, s: (t, 0)
     in_specs = [
-        pl.BlockSpec((W, blk, C),
-                     lambda t, d, k, s: (0, (t + k[d]) % Ty, 0)),
-        pl.BlockSpec((1, blk, C), lambda t, d, k, s: (d, t, 0)),
-        pl.BlockSpec((blk, C), lambda t, d, k, s: (t, 0)),
+        pl.BlockSpec((W, blk, C), y_map),
+        pl.BlockSpec((1, blk, C), tab_map),
+        pl.BlockSpec((blk, C), row_map),
     ]
     operands = [y, colidx, gate]
+    if fused:
+        in_specs.append(pl.BlockSpec((blk, C), ok_map))
+        operands.append(src_ok)
     if fanout > 0:
         assert shift is not None, "bounded fanout needs a shift plane"
-        in_specs.append(pl.BlockSpec((blk, C), lambda t, d, k, s: (t, 0)))
+        in_specs.append(pl.BlockSpec((blk, C), row_map))
         operands.append(shift)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=n_pref,
         grid=(T, D),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((W, blk, C), lambda t, d, k, s: (0, t, 0)),
+        out_specs=pl.BlockSpec((W, blk, C),
+                               (lambda t, d, k, s, yt: (0, t, 0)) if fused
+                               else (lambda t, d, k, s: (0, t, 0))),
     )
     return pl.pallas_call(
-        functools.partial(_pass_kernel, pull, W, fanout),
+        functools.partial(_pass_kernel, pull, W, fanout, fused, n_pref),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((W, R, C), jnp.int32),
         interpret=interpret,
-    )(rolls, subrolls, *operands)
+    )(*prefetch, *operands)
 
 
 def _count_kernel(rolls_ref, subrolls_ref, y_ref, col_ref, gate_ref,
@@ -243,9 +295,13 @@ def rewire_candidates(grows: jax.Array, n_slots: int, round_idx,
                         jnp.int32(seed)).astype(jnp.int8)
 
 
-def _liveness_kernel(max_strikes, rolls_ref, subrolls_ref, gbase_ref,
-                     meta_ref, y_ref, col_ref, strikes_ref, gate_ref,
-                     col_out, strikes_out, evict_out):
+def _liveness_kernel(max_strikes, n_pref, *refs):
+    pref, rest = refs[:n_pref], refs[n_pref:]
+    # pref = rolls, subrolls, (ytab), gbase, meta — ytab only drives the
+    # y index map; the body reads subrolls/gbase/meta by position
+    subrolls_ref, gbase_ref, meta_ref = pref[1], pref[-2], pref[-1]
+    (y_ref, col_ref, strikes_ref, gate_ref,
+     col_out, strikes_out, evict_out) = rest
     """Per-slot liveness observation + 3-strike eviction + in-row rewire.
 
     Vectorizes the reference's pingLoop/handleDeadPeer pair
@@ -291,6 +347,7 @@ def liveness_pass(y_alive: jax.Array, colidx: jax.Array,
                   strikes: jax.Array, gate: jax.Array,
                   rolls: jax.Array, subrolls: jax.Array, *,
                   gbase: jax.Array, round_idx, hash_seed,
+                  ytab: jax.Array | None = None,
                   max_strikes: int = 3, rowblk: int = 512,
                   interpret: bool = False
                   ) -> tuple[jax.Array, jax.Array, jax.Array]:
@@ -323,24 +380,39 @@ def liveness_pass(y_alive: jax.Array, colidx: jax.Array,
     Ty = Ry // blk
     meta = jnp.stack([jnp.int32(round_idx), jnp.int32(hash_seed)])
 
+    if ytab is not None:
+        # Block-perm overlay: y_alive is the RAW alive plane; perm∘roll
+        # rides the index table (see gossip_pass)
+        assert ytab.shape == (D, T), (ytab.shape, (D, T))
+        n_pref = 5
+        prefetch = (rolls, subrolls, ytab, gbase, meta)
+        y_map = lambda t, d, k, s, yt, g, m: (yt[d, t], 0)
+        tab_map = lambda t, d, k, s, yt, g, m: (d, t, 0)
+        row_map = lambda t, d, k, s, yt, g, m: (t, 0)
+    else:
+        n_pref = 4
+        prefetch = (rolls, subrolls, gbase, meta)
+        y_map = lambda t, d, k, s, g, m: ((t + k[d]) % Ty, 0)
+        tab_map = lambda t, d, k, s, g, m: (d, t, 0)
+        row_map = lambda t, d, k, s, g, m: (t, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
+        num_scalar_prefetch=n_pref,
         grid=(T, D),
         in_specs=[
-            pl.BlockSpec((blk, C),
-                         lambda t, d, k, s, g, m: ((t + k[d]) % Ty, 0)),
-            pl.BlockSpec((1, blk, C), lambda t, d, k, s, g, m: (d, t, 0)),
-            pl.BlockSpec((1, blk, C), lambda t, d, k, s, g, m: (d, t, 0)),
-            pl.BlockSpec((blk, C), lambda t, d, k, s, g, m: (t, 0)),
+            pl.BlockSpec((blk, C), y_map),
+            pl.BlockSpec((1, blk, C), tab_map),
+            pl.BlockSpec((1, blk, C), tab_map),
+            pl.BlockSpec((blk, C), row_map),
         ],
         out_specs=[
-            pl.BlockSpec((1, blk, C), lambda t, d, k, s, g, m: (d, t, 0)),
-            pl.BlockSpec((1, blk, C), lambda t, d, k, s, g, m: (d, t, 0)),
-            pl.BlockSpec((1, blk, C), lambda t, d, k, s, g, m: (d, t, 0)),
+            pl.BlockSpec((1, blk, C), tab_map),
+            pl.BlockSpec((1, blk, C), tab_map),
+            pl.BlockSpec((1, blk, C), tab_map),
         ],
     )
     return pl.pallas_call(
-        functools.partial(_liveness_kernel, max_strikes),
+        functools.partial(_liveness_kernel, max_strikes, n_pref),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((D, R, C), jnp.int8),
@@ -348,7 +420,7 @@ def liveness_pass(y_alive: jax.Array, colidx: jax.Array,
             jax.ShapeDtypeStruct((D, R, C), jnp.int8),
         ],
         interpret=interpret,
-    )(rolls, subrolls, gbase, meta, y_alive, colidx, strikes, gate)
+    )(*prefetch, y_alive, colidx, strikes, gate)
 
 
 def neighbor_ids(perm, rolls, subrolls, colidx, *, rowblk: int = 512):

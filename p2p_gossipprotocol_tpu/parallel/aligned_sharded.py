@@ -58,7 +58,8 @@ def _topo_spec(topo: AlignedTopology) -> AlignedTopology:
     return topo.replace(
         perm=P(), rolls=P(), subrolls=P(),
         colidx=P(None, AXIS, None), deg=P(AXIS, None),
-        valid_w=P(AXIS, None))
+        valid_w=P(AXIS, None),
+        ytab=None if topo.ytab is None else P())
 
 
 def _state_spec(liveness: bool) -> AlignedState:
