@@ -141,8 +141,10 @@ def main():
 
     # 6c) block-perm fused path: the ytab index-table maps + in-kernel
     #     src_ok masking (round-5 work — never Mosaic-compiled either)
+    # rowblk=8 keeps t_blocks > 1 at this small n, so the ytab index
+    # table is non-trivial under Mosaic (8-sublane aligned)
     topo_bp = build_aligned(seed=3, n=n, n_slots=8, roll_groups=4,
-                            block_perm=True)
+                            rowblk=8, block_perm=True)
     results.append(_check("block_perm_fused", lambda: _run_pair(
         lambda interp: AlignedSimulator(
             topo=topo_bp, n_msgs=64, mode="pushpull",

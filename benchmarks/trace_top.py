@@ -1,0 +1,79 @@
+"""Summarize a jax.profiler trace: top ops by total device time.
+
+Feeds the traffic-model reconciliation (round-4 verdict item 2): point
+it at the `.trace.json.gz` a capture wrote (e.g. by
+benchmarks/measure_round4.py into benchmarks/profiles/) and compare the
+dominant kernels' share of the round against hbm_bytes_per_round's
+per-term accounting.
+
+    python benchmarks/trace_top.py benchmarks/profiles/r4_10m [N]
+
+Accepts a trace directory (finds the newest *.trace.json.gz under it)
+or a direct file path.  Prints one JSON line per op: name, calls, total
+ms, share of the traced device time.
+"""
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+
+def find_trace(path: str) -> str:
+    if os.path.isfile(path):
+        return path
+    hits = sorted(glob.glob(os.path.join(path, "**", "*.trace.json.gz"),
+                            recursive=True), key=os.path.getmtime)
+    if not hits:
+        raise SystemExit(f"no *.trace.json.gz under {path!r}")
+    return hits[-1]
+
+
+def summarize(trace_file: str, top_n: int = 20) -> list[dict]:
+    with gzip.open(trace_file, "rt") as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", [])
+    # keep complete ('X') events from device lanes; host python lanes
+    # carry huge nested spans that would double-count
+    dur_by_name: dict[str, float] = defaultdict(float)
+    calls: dict[str, int] = defaultdict(int)
+    pid_names = {e.get("pid"): e.get("args", {}).get("name", "")
+                 for e in events
+                 if e.get("ph") == "M" and e.get("name") == "process_name"}
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        lane = pid_names.get(e.get("pid"), "")
+        if "python" in lane.lower():
+            continue
+        name = e.get("name", "?")
+        if name.startswith("$"):   # python source spans ($file.py:line)
+            continue
+        dur_by_name[name] += e["dur"]          # microseconds
+        calls[name] += 1
+    total = sum(dur_by_name.values()) or 1.0
+    rows = [{"op": k, "calls": calls[k],
+             "total_ms": round(v / 1e3, 3),
+             "share": round(v / total, 4)}
+            for k, v in sorted(dur_by_name.items(),
+                               key=lambda kv: -kv[1])[:top_n]]
+    return rows
+
+
+def main() -> int:
+    if len(sys.argv) < 2:
+        print(__doc__, file=sys.stderr)
+        return 1
+    trace_file = find_trace(sys.argv[1])
+    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+    print(json.dumps({"trace": trace_file}))
+    for row in summarize(trace_file, top_n):
+        print(json.dumps(row))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
