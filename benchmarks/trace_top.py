@@ -43,12 +43,26 @@ def summarize(trace_file: str, top_n: int = 20) -> list[dict]:
     pid_names = {e.get("pid"): e.get("args", {}).get("name", "")
                  for e in events
                  if e.get("ph") == "M" and e.get("name") == "process_name"}
+    tid_names = {(e.get("pid"), e.get("tid")):
+                 e.get("args", {}).get("name", "")
+                 for e in events
+                 if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    # Device traces nest module/step spans around the op spans on the
+    # same pid — summing every lane would double-count device time and
+    # halve each kernel's share.  Keep ONLY the "XLA Ops" lanes when
+    # the trace has them (TPU traces do); fall back to the
+    # everything-but-python filter otherwise (CPU rehearsal traces).
+    op_lanes = {k for k, v in tid_names.items() if "XLA Ops" in v}
     for e in events:
         if e.get("ph") != "X" or "dur" not in e:
             continue
-        lane = pid_names.get(e.get("pid"), "")
-        if "python" in lane.lower():
-            continue
+        if op_lanes:
+            if (e.get("pid"), e.get("tid")) not in op_lanes:
+                continue
+        else:
+            lane = pid_names.get(e.get("pid"), "")
+            if "python" in lane.lower():
+                continue
         name = e.get("name", "?")
         if name.startswith("$"):   # python source spans ($file.py:line)
             continue

@@ -109,7 +109,12 @@ class AlignedSIRSimulator:
                              degree_law=law,
                              powerlaw_alpha=cfg.powerlaw_alpha,
                              n_shards=n_shards,
-                             roll_groups=cfg.roll_groups or None)
+                             roll_groups=cfg.roll_groups or None,
+                             # honored for overlay-family parity; the
+                             # SIR round takes the legacy (prow) route
+                             # either way — count_pass is one flag
+                             # plane, so there is no 3W prep to fuse
+                             block_perm=bool(cfg.block_perm))
         return cls(topo=topo, beta=cfg.sir_beta, gamma=cfg.sir_gamma,
                    churn=ChurnConfig(rate=cfg.churn_rate),
                    seed=cfg.prng_seed)

@@ -207,3 +207,21 @@ def test_block_perm_rolls_guaranteed_distinct():
         topo = build_aligned(seed=seed, n=262144, n_slots=16,
                              roll_groups=2, block_perm=True)
         assert len(np.unique(np.asarray(topo.rolls))) == 2, seed
+
+
+def test_block_perm_sir_runs(tmp_path):
+    """block_perm=1 with mode=sir: the config key is honored (overlay
+    family parity) and the SIR engine runs it via the legacy route —
+    no silent key drop, no capability edge."""
+    from p2p_gossipprotocol_tpu.aligned_sir import AlignedSIRSimulator
+    from p2p_gossipprotocol_tpu.config import NetworkConfig
+
+    cfg = tmp_path / "net.txt"
+    cfg.write_text("10.0.0.1:8000\nbackend=jax\nengine=aligned\n"
+                   "graph=er\nn_peers=4096\nmode=sir\nblock_perm=1\n"
+                   "roll_groups=4\n")
+    sim = AlignedSIRSimulator.from_config(NetworkConfig(str(cfg)))
+    assert sim.topo.ytab is not None
+    res = sim.run(16)
+    assert int(res.infected[0]) > 0
+    assert int(res.new_infections.sum()) > 0
