@@ -10,7 +10,6 @@ produce BITWISE-identical results to the legacy route (prow + host
 masking) on the same topology — not a statistical match."""
 
 import numpy as np
-import jax.numpy as jnp
 
 from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator,
                                             build_aligned)
