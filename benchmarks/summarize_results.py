@@ -1,0 +1,134 @@
+"""Collect everything the watchdog chain produced into one report.
+
+Reads (whatever exists):
+  results/mosaic_smoke.jsonl     — compile-gate verdicts
+  results/bench_r5_tpu.json      — the headline bench line
+  results/round4_tpu.jsonl       — stride/roll-group A/B, 10M rows, SIR
+  results/round5_tpu.jsonl       — prep-term / roll-reuse / block-perm /
+                                   stagger microbenches
+  results/baselines_tpu.jsonl    — the five BASELINE configs (appended)
+
+Prints a markdown summary ready for BASELINE.md plus machine verdicts:
+north-star vs the round-3 number, whether the roll-group VMEM reuse
+measured real, prep-term model-vs-measured, and the block-perm A/B.
+
+    python benchmarks/summarize_results.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results")
+R3_NORTH_STAR_S = 0.0716        # BENCH_r03: 1M to 99% on the chip
+
+
+def rows(name):
+    path = os.path.join(HERE, name)
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if ln:
+                try:
+                    out.append(json.loads(ln))
+                except json.JSONDecodeError:
+                    continue
+    return out
+
+
+def main() -> int:
+    report = []
+
+    smoke = rows("mosaic_smoke.jsonl")
+    if smoke:
+        summ = [r for r in smoke if r.get("variant") == "_summary"]
+        fails = [r["variant"] for r in smoke
+                 if r.get("ok") is False
+                 and not r.get("variant", "").startswith("_")]
+        report.append("## Mosaic compile gate")
+        if summ:
+            s = summ[-1]
+            report.append(f"- {s.get('passed')}/{s.get('total')} variants "
+                          f"compiled + matched interpret bitwise"
+                          + (f"; FAILED: {fails}" if fails else ""))
+
+    bench = rows("bench_r5_tpu.json")
+    if bench:
+        b = bench[-1]
+        report.append("## Headline bench")
+        report.append(f"- {b.get('metric')}: **{b.get('value')} s** "
+                      f"(platform {b.get('platform')}, fallback "
+                      f"{b.get('fallback')}, vs_baseline "
+                      f"{b.get('vs_baseline')})")
+        if (b.get("platform") in ("tpu", "axon") and b.get("value")
+                and b.get("n_peers") == 1 << 20):
+            ratio = R3_NORTH_STAR_S / b["value"]
+            report.append(f"- vs round-3 hardware number "
+                          f"({R3_NORTH_STAR_S} s): {ratio:.2f}x")
+
+    r4 = rows("round4_tpu.jsonl")
+    if r4:
+        report.append("## Round-4 harness (stride x groups, 10M, SIR)")
+        for r in r4:
+            cfg = r.get("config", "?")
+            core = {k: r[k] for k in ("rounds", "wall_s", "ms_per_round",
+                                      "final_coverage", "achieved_gb_s",
+                                      "peak_infected", "attack_rate")
+                    if k in r}
+            report.append(f"- `{cfg}`: {json.dumps(core)}")
+
+    r5 = rows("round5_tpu.jsonl")
+    if r5:
+        report.append("## Round-5 microbenches")
+        kern = {r["config"]: r for r in r5
+                if r.get("config", "").startswith("kernel_only_rolls_")}
+        for r in r5:
+            cfg = r.get("config", "?")
+            if cfg.startswith("_"):
+                continue
+            core = {k: r[k] for k in ("ms", "ms_per_round", "rounds",
+                                      "achieved_gb_s_vs_model",
+                                      "achieved_gb_s", "final_coverage",
+                                      "unique_rolls", "value")
+                    if k in r}
+            report.append(f"- `{cfg}`: {json.dumps(core)}")
+        k16 = kern.get("kernel_only_rolls_16", {}).get("ms")
+        k4 = kern.get("kernel_only_rolls_4", {}).get("ms")
+        if k16 and k4:
+            report.append(
+                f"- VERDICT roll-reuse: 16-roll / 4-roll kernel time = "
+                f"{k16 / k4:.2f}x (reuse real if ~2-4x, absent if ~1x)")
+        bp = {r["config"]: r for r in r5 if "block_perm" in r}
+        legacy = bp.get("1m_256msg_block_perm_0_groups_4")
+        fused2 = bp.get("1m_256msg_block_perm_1_groups_2")
+        if legacy and fused2 and legacy.get("ms_per_round"):
+            cut = 1 - fused2["ms_per_round"] / legacy["ms_per_round"]
+            report.append(f"- VERDICT block-perm: fused-2 vs legacy-4 "
+                          f"ms/round cut = {cut:.1%} (model said 43%)")
+
+    base = rows("baselines_tpu.jsonl")
+    if base:
+        report.append("## Baseline configs (latest rows)")
+        latest = {}
+        for r in base:
+            latest[r.get("config")] = r
+        for cfg, r in latest.items():
+            core = {k: r[k] for k in ("n_peers", "value", "unit",
+                                      "wall_s", "rounds", "platform")
+                    if k in r}
+            report.append(f"- `{cfg}`: {json.dumps(core)}")
+
+    if not report:
+        print("no results found under benchmarks/results/",
+              file=sys.stderr)
+        return 1
+    print("\n".join(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
