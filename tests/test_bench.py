@@ -74,3 +74,18 @@ def test_bench_reports_traffic_model():
     assert rec["achieved_gb_s"] is not None
     assert rec["liveness_every"] == 3
     assert rec["roll_groups"] == 4
+
+
+def test_bench_stagger_and_block_perm_knobs():
+    """The round-5 env knobs reach the bench scenario and stamp the
+    line: staggered generation stretches rounds (the last rumor enters
+    at round (n_msgs-1)*k) and block_perm runs the fused overlay."""
+    proc, rec = _run({"GOSSIP_BENCH_PLATFORM": "cpu",
+                      "JAX_PLATFORMS": "cpu",
+                      "GOSSIP_BENCH_STAGGER": "1",
+                      "GOSSIP_BENCH_BLOCK_PERM": "1"})
+    assert proc.returncode == 0, proc.stderr
+    assert rec["message_stagger"] == 1
+    assert rec["block_perm"] is True
+    assert rec["rounds"] >= 8          # schedule end for 8 msgs at k=1
+    assert rec["value"] is not None

@@ -1,0 +1,66 @@
+"""trace_top.py: the trace summarizer feeding the traffic-model
+reconciliation must keep only the XLA Ops lanes (device traces nest
+module/step spans around the op spans — summing every lane would
+double-count and halve each kernel's share)."""
+
+import gzip
+import json
+import subprocess
+import sys
+
+
+def _write_trace(path, events):
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+
+def test_xla_ops_lane_filter(tmp_path):
+    trace = tmp_path / "t.trace.json.gz"
+    _write_trace(str(trace), [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 10,
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 11,
+         "args": {"name": "XLA Modules"}},
+        # the module span ENCLOSES the two op spans — it must not count
+        {"ph": "X", "pid": 1, "tid": 11, "name": "jit_scan",
+         "ts": 0, "dur": 1000},
+        {"ph": "X", "pid": 1, "tid": 10, "name": "fusion.1",
+         "ts": 0, "dur": 600},
+        {"ph": "X", "pid": 1, "tid": 10, "name": "dynamic-gather.2",
+         "ts": 600, "dur": 400},
+    ])
+    proc = subprocess.run(
+        [sys.executable, "/root/repo/benchmarks/trace_top.py",
+         str(trace)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    lines = [json.loads(ln) for ln in proc.stdout.strip().splitlines()]
+    rows = {r["op"]: r for r in lines[1:]}
+    assert "jit_scan" not in rows            # module lane excluded
+    assert rows["fusion.1"]["share"] == 0.6  # shares of OP time only
+    assert rows["dynamic-gather.2"]["share"] == 0.4
+
+
+def test_fallback_without_op_lanes(tmp_path):
+    """CPU rehearsal traces have no XLA Ops lanes; the summarizer falls
+    back to the everything-but-python filter instead of printing
+    nothing."""
+    trace = tmp_path / "t.trace.json.gz"
+    _write_trace(str(trace), [
+        {"ph": "M", "name": "process_name", "pid": 2,
+         "args": {"name": "python"}},
+        {"ph": "X", "pid": 2, "tid": 1, "name": "$pjit.py:1 cache_miss",
+         "ts": 0, "dur": 500},
+        {"ph": "X", "pid": 3, "tid": 1, "name": "PjitFunction(f)",
+         "ts": 0, "dur": 300},
+    ])
+    proc = subprocess.run(
+        [sys.executable, "/root/repo/benchmarks/trace_top.py",
+         str(trace)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    lines = [json.loads(ln) for ln in proc.stdout.strip().splitlines()]
+    rows = {r["op"]: r for r in lines[1:]}
+    assert rows == {"PjitFunction(f)": rows["PjitFunction(f)"]}
