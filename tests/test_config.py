@@ -247,3 +247,21 @@ def test_config_parser_never_crashes_on_junk(tmp_path):
             NetworkConfig(str(cfg))
         except ConfigError:
             pass
+
+
+def test_examples_scale_config_selects_the_fused_engine():
+    """examples/scale.txt (the scale-engine showcase) parses and routes
+    onto the aligned engine with the round-5 features on — the example
+    must never rot."""
+    from p2p_gossipprotocol_tpu.config import NetworkConfig
+    from p2p_gossipprotocol_tpu.engines import build_simulator
+
+    cfg = NetworkConfig("/root/repo/examples/scale.txt")
+    assert (cfg.engine, cfg.mode) == ("aligned", "pushpull")
+    assert cfg.block_perm == 1 and cfg.message_stagger == 1
+    # cheap instantiation: shrink the peer count, keep every knob
+    sim, engine = build_simulator(cfg, n_peers=4096)
+    assert engine == "aligned"
+    assert sim.topo.ytab is not None
+    assert sim.message_stagger == 1
+    assert sim.liveness_every == 3          # 13 s / 5 s
