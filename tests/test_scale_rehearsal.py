@@ -70,3 +70,28 @@ def test_config5_rehearsal_2d_mesh(devices8):
     assert float(res.coverage[-1]) >= 0.99
     assert int(np.asarray(res.evictions).sum()) > 0
     assert int(res.live_peers[-1]) < rows * 0.97
+
+
+def test_config5_rehearsal_fused_stagger_128k(devices8):
+    """The round-5 paths at rehearsal scale, CI-default: the fused
+    block-perm overlay + staggered generation on the 8-shard engine
+    with churn + byzantine + eviction — the same config-5 feature set,
+    through the ytab index-table kernels."""
+    from p2p_gossipprotocol_tpu.aligned import build_aligned
+    from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+    from p2p_gossipprotocol_tpu.parallel import (AlignedShardedSimulator,
+                                                 make_mesh)
+
+    rows = 1 << 17
+    topo = build_aligned(seed=0, n=rows, n_slots=8,
+                         degree_law="powerlaw", n_shards=8,
+                         roll_groups=4, block_perm=True)
+    sim = AlignedShardedSimulator(
+        topo=topo, mesh=make_mesh(8), n_msgs=4, mode="pushpull",
+        churn=ChurnConfig(rate=0.05, kill_round=1),
+        byzantine_fraction=0.1, n_honest_msgs=3, max_strikes=3,
+        message_stagger=2, seed=0)
+    res = sim.run(24)
+    assert float(res.coverage[-1]) >= 0.99
+    assert int(np.asarray(res.evictions).sum()) > 0
+    assert int(res.live_peers[-1]) < rows * 0.97
