@@ -12,14 +12,22 @@ Layout:
   config        — network.txt parser (reference config.cpp semantics)
   info          — PeerInfo/Message data model + SHA-256 identity
   graph         — overlay construction: power-law fanout, ER, BA generators
-  state         — simulation state pytrees
+  state         — simulation state pytrees; message plan / stagger schedule
   models/       — dissemination models: push flood, push-pull, SIR, Byzantine
-  ops/          — propagation primitives (edge OR-scatter, neighbor sampling)
-  parallel/     — mesh + sharded step (pjit/shard_map over the peer axis)
-  sim           — Simulator: scan loop, metrics, coverage
+  ops/          — propagation primitives (edge OR-scatter, neighbor
+                  sampling) + the pallas kernels (aligned_kernel)
+  sim           — Simulator (exact edge engine): scan loop, metrics
+  aligned       — the scale engine: register-tiled overlay (row- or
+                  block-granular permutation), bit-packed planes
+  aligned_sir   — SIR epidemic on the aligned overlay
+  parallel/     — mesh + sharded engines (shard_map over peers, and the
+                  2-D msgs x peers mesh)
+  engines       — THE engine-selection table (config -> simulator),
+                  shared by the CLI and the facade
   liveness      — churn schedules, 3-strike eviction, rewiring
   transport/    — Transport interface; JAX and socket implementations
-  peer / seed   — socket-mode runtimes (asyncio)
+  peer / seed   — socket-mode runtimes (threaded TCP)
+  utils/        — checkpoint (orbax), metrics/JSONL, logging
   wrapper       — Peer lifecycle facade; cli — ``peer_network`` entry point
 """
 
