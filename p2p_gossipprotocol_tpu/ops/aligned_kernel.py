@@ -10,9 +10,13 @@ streaming loads, lane-wise `tpu.dynamic_gather` (take_along_axis over the
 
     neighbor_d(r, c) = ( perm[ roll_d(r) ],  colidx_d[r, c] )
 
-* ``perm``    — one static random row permutation (applied OUTSIDE the
-  kernel as a 512-byte-row XLA gather: row gathers are per-row bound,
-  8192 rows ≈ 0.2 ms — cheap at this granularity);
+* ``perm``    — one static random row permutation.  Row-granular
+  overlays apply it OUTSIDE the kernel as a 512-byte-row XLA gather
+  (row gathers are per-row bound, 8192 rows ≈ 0.2 ms); block-granular
+  overlays (``build_aligned(block_perm=True)``) fold ``perm∘roll`` into
+  a per-slot block table (``ytab``) the kernel consumes as a
+  scalar-prefetch index map, so the gather pass does not exist at all
+  and the send mask rides in as one ``src_ok`` plane;
 * ``roll_d``  — per-slot block roll, applied FOR FREE via the BlockSpec
   index map (the DMA just reads a different block);
 * ``colidx``  — per-peer random lane choice, the in-kernel
