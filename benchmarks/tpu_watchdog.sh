@@ -3,13 +3,14 @@
 # with a hard-kill timeout (jax.devices() HANGS in C when the tunnel is
 # down — a plain timeout won't kill it); the moment a probe succeeds,
 # run the measurement chain:
-#   1. benchmarks/mosaic_smoke.py   — Mosaic compile gate, every kernel
+#   1. bench.py                     — the driver's headline metric FIRST
+#      (a short tunnel window must yield the most important artifact)
+#   2. benchmarks/mosaic_smoke.py   — Mosaic compile gate, every kernel
 #      variant, bitwise vs interpret
-#   2. bench.py                     — the driver's headline metric
 #   3. benchmarks/measure_round4.py — stride/roll-group A/B at 1M,
 #      10M x 256 headline, 10M SIR, profiler trace
 #   4. benchmarks/measure_round5.py — prep-term + roll-reuse
-#      microbenches, stagger A/B
+#      microbenches, block-perm and stagger A/Bs
 #   5. benchmarks/run_baselines.py  — the five BASELINE configs
 # Probes every 90 s; everything appends to benchmarks/results/.
 set -u
@@ -27,11 +28,11 @@ while true; do
        jax.jit(lambda x: x + 1)(jnp.ones((8, 128))).block_until_ready(); \
        print(jax.devices())" >>"$LOG" 2>&1; then
     say "tunnel UP — running measurement chain"
-    timeout -k 30 2400 python benchmarks/mosaic_smoke.py >>"$LOG" 2>&1
-    say "mosaic_smoke exit=$?"
     timeout -k 30 3600 python bench.py \
       >benchmarks/results/bench_r5_tpu.json 2>>"$LOG"
     say "bench exit=$?"
+    timeout -k 30 2400 python benchmarks/mosaic_smoke.py >>"$LOG" 2>&1
+    say "mosaic_smoke exit=$?"
     timeout -k 30 7200 python benchmarks/measure_round4.py >>"$LOG" 2>&1
     say "measure_round4 exit=$?"
     timeout -k 30 3600 python benchmarks/measure_round5.py >>"$LOG" 2>&1
