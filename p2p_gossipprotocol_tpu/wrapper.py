@@ -35,6 +35,9 @@ class Peer:
         if cfg.backend == "socket":
             from p2p_gossipprotocol_tpu.peer import PeerNode
 
+            #: same attribute on both backends (the jax path sets the
+            #: engine-table name), so callers can always read it
+            self.engine = "socket"
             seeds = [PeerInfo(n.ip, n.port) for n in cfg.get_seed_nodes()]
             self.node = PeerNode(
                 cfg.get_local_ip(), cfg.get_local_port(), seeds,
