@@ -120,7 +120,7 @@ def _bench_aligned(n, n_msgs, degree, mode):
     import numpy as np
 
     from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator,
-                                                _popcount_sum,
+                                                _pair_int, _popcount_pair,
                                                 aligned_coverage,
                                                 build_aligned)
     from p2p_gossipprotocol_tpu.liveness import ChurnConfig
@@ -153,7 +153,9 @@ def _bench_aligned(n, n_msgs, degree, mode):
     state, topo2, rounds, wall = sim.run_to_coverage(target=TARGET_COV,
                                                      max_rounds=MAX_ROUNDS)
     _check_converged(aligned_coverage(sim, state, topo2), rounds)
-    total_seen = int(jax.device_get(_popcount_sum(state.seen_w)))
+    # exact [hi, lo] pair: a flat int32 popcount wraps above 2^31 set
+    # bits (10M peers x 256 messages)
+    total_seen = _pair_int(jax.device_get(_popcount_pair(state.seen_w)))
     n_edges = int(np.asarray(topo.deg).sum())
     bytes_round = sim.hbm_bytes_per_round()
     extras = {

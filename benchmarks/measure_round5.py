@@ -186,6 +186,47 @@ def bench_block_perm_ab(n=1 << 20):
                   sim.hbm_bytes_per_round() * 12 / res.wall_s / 1e9, 1)})
 
 
+def bench_fuse_update_ab(n=1 << 20):
+    """In-kernel seen-update (fuse_update) vs the XLA elementwise update,
+    at the headline 1M x 16 config and at 1M x 256 (W=8, where the
+    update planes are widest), on both overlay families.  Model: -2W
+    streams/round push, net -2W pushpull (docs/PERFORMANCE.md
+    "next factor")."""
+    from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator,
+                                                build_aligned)
+    from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+
+    from p2p_gossipprotocol_tpu.aligned import (MAX_WORDS_X_ROWBLK,
+                                                n_msg_words)
+
+    for n_msgs, bp, groups in ((16, False, 4), (16, True, 2),
+                               (256, False, 4), (256, True, 2)):
+        # fused update halves the kernel VMEM budget: bound the row
+        # block by the halved budget directly (same rule as from_config)
+        blk = min(512, max(8, (MAX_WORDS_X_ROWBLK // 2)
+                           // n_msg_words(n_msgs) // 8 * 8))
+        topo = build_aligned(seed=7, n=n, n_slots=16,
+                             degree_law="powerlaw", roll_groups=groups,
+                             n_msgs=n_msgs, rowblk=blk, block_perm=bp)
+        for fuse in (False, True):
+            sim = AlignedSimulator(
+                topo=topo, n_msgs=n_msgs, mode="pushpull",
+                churn=ChurnConfig(rate=0.05, kill_round=1),
+                max_strikes=3, liveness_every=3, fuse_update=fuse, seed=1)
+            res = sim.run(12, warmup=True)
+            emit({"config": (f"1m_{n_msgs}msg_bp{int(bp)}_g{groups}"
+                             f"_fuse_{int(fuse)}"),
+                  "n_peers": n, "n_msgs": n_msgs, "block_perm": bp,
+                  "roll_groups": groups, "fuse_update": fuse,
+                  "wall_s": round(res.wall_s, 4),
+                  "ms_per_round": round(res.wall_s / 12 * 1000, 3),
+                  "final_coverage": round(float(res.coverage[-1]), 5),
+                  "bytes_per_round": sim.hbm_bytes_per_round(),
+                  "achieved_gb_s": round(
+                      sim.hbm_bytes_per_round() * 12 / res.wall_s / 1e9,
+                      1)})
+
+
 def bench_stagger_ab(n=1 << 20):
     from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator,
                                                 aligned_coverage,
@@ -221,6 +262,7 @@ def main():
     bench_prep_term()
     bench_roll_group_reuse()
     bench_block_perm_ab()
+    bench_fuse_update_ab()
     bench_stagger_ab()
     return 0
 

@@ -155,6 +155,24 @@ def main():
             topo=topo_bp, n_msgs=32, mode="push", fanout=2, seed=1,
             interpret=interp)) and None))
 
+    # 6d) in-kernel seen-update (round-5 fuse_update): finalize on the
+    #     push kernel, and on the pull kernel with the pushpull
+    #     accumulator chaining (acc_init) — on BOTH overlay families
+    results.append(_check("fuse_update_push", lambda: _run_pair(
+        lambda interp: AlignedSimulator(
+            topo=topo, n_msgs=64, mode="push", fuse_update=True, seed=1,
+            interpret=interp)) and None))
+    results.append(_check("fuse_update_pushpull", lambda: _run_pair(
+        lambda interp: AlignedSimulator(
+            topo=topo_rg, n_msgs=64, mode="pushpull", fuse_update=True,
+            churn=ChurnConfig(rate=0.05, kill_round=1), max_strikes=2,
+            liveness_every=3, seed=1, interpret=interp)) and None))
+    results.append(_check("fuse_update_block_perm", lambda: _run_pair(
+        lambda interp: AlignedSimulator(
+            topo=topo_bp, n_msgs=64, mode="pushpull", fuse_update=True,
+            churn=ChurnConfig(rate=0.05, kill_round=1), max_strikes=2,
+            liveness_every=2, seed=1, interpret=interp)) and None))
+
     # 7) SIR count_pass
     def sir_pair():
         def mk(interp):

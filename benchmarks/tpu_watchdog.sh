@@ -1,59 +1,125 @@
 #!/bin/bash
-# TPU tunnel watchdog (round-5 verdict item 1): probe the axon backend
-# with a hard-kill timeout (jax.devices() HANGS in C when the tunnel is
-# down — a plain timeout won't kill it); the moment a probe succeeds,
-# run the measurement chain:
-#   1. bench.py                     — the driver's headline metric FIRST
-#      (a short tunnel window must yield the most important artifact)
-#   2. benchmarks/mosaic_smoke.py   — Mosaic compile gate, every kernel
-#      variant, bitwise vs interpret
-#   3. benchmarks/measure_round4.py — stride/roll-group A/B at 1M,
-#      10M x 256 headline, 10M SIR, profiler trace
-#   4. benchmarks/measure_round5.py — prep-term + roll-reuse
-#      microbenches, block-perm and stagger A/Bs
-#   5. benchmarks/run_baselines.py  — the five BASELINE configs
-# Probes every 90 s; everything appends to benchmarks/results/.
+# TPU tunnel watchdog v2 (round-5): probe the axon backend with a
+# hard-kill timeout (jax.devices() HANGS in C when the tunnel is down —
+# a plain timeout won't kill it), and run the measurement chain while
+# the tunnel is up.  v2 lessons from the first window (01:01-01:11Z,
+# ten minutes, then the tunnel hung mid-measure_round5):
+#   * PER-STEP done-stamps: a step that exits 0 is never re-run, so a
+#     short tunnel window always makes forward progress and a re-opened
+#     window resumes where the last one died instead of repeating work;
+#   * re-probe BETWEEN steps: when a step fails, check the tunnel
+#     before starting the next one — a dead tunnel must put us back on
+#     probe duty immediately, not burn every remaining step's timeout;
+#   * stand down only when EVERY step has landed.
+# Order: headline bench first — a short window must yield the most
+# important artifact; then the Mosaic compile gate, then the harnesses.
 set -u
 cd /root/repo
 LOG=${GOSSIP_WATCHDOG_LOG:-benchmarks/results/watchdog_r5.log}
-mkdir -p benchmarks/results
+STAMPS=benchmarks/results/stamps
+mkdir -p benchmarks/results "$STAMPS"
 export PYTHONPATH=/root/repo:/root/.axon_site
 
 say() { echo "$(date -u +%FT%TZ) $*" >>"$LOG"; }
 
-say "watchdog start (pid $$)"
-while true; do
-  if timeout -k 10 120 python -c \
-      "import jax, jax.numpy as jnp; \
-       jax.jit(lambda x: x + 1)(jnp.ones((8, 128))).block_until_ready(); \
-       print(jax.devices())" >>"$LOG" 2>&1; then
-    say "tunnel UP — running measurement chain"
-    timeout -k 30 3600 python bench.py \
-      >benchmarks/results/bench_r5_tpu.json 2>>"$LOG"
-    say "bench exit=$?"
-    timeout -k 30 2400 python benchmarks/mosaic_smoke.py >>"$LOG" 2>&1
-    say "mosaic_smoke exit=$?"
-    timeout -k 30 7200 python benchmarks/measure_round4.py >>"$LOG" 2>&1
-    say "measure_round4 exit=$?"
-    timeout -k 30 3600 python benchmarks/measure_round5.py >>"$LOG" 2>&1
-    say "measure_round5 exit=$?"
-    timeout -k 30 7200 python benchmarks/run_baselines.py >>"$LOG" 2>&1
-    say "run_baselines exit=$?"
-    # Only stand down once the HEADLINE datapoint really landed on the
-    # chip — a tunnel that dropped mid-chain (every step has its own
-    # timeout) must put the watchdog back on probe duty, not end it.
-    if python - <<'PY' >>"$LOG" 2>&1
+probe() {
+  timeout -k 10 120 python -c \
+    "import jax, jax.numpy as jnp; \
+     jax.jit(lambda x: x + 1)(jnp.ones((8, 128))).block_until_ready(); \
+     print(jax.devices())" >>"$LOG" 2>&1
+}
+
+# A step is SETTLED when it succeeded (.done) or exhausted its attempt
+# budget (.gave_up) — a deterministically failing step must not starve
+# the steps after it, nor hot-loop: each outer pass tries it once, and
+# after MAX_TRIES it is parked.  Attempts are charged ONLY when the
+# tunnel is verifiably up right after the failure (a window that dies
+# mid-step is the tunnel's fault, not the step's) — see record_fail in
+# the main loop.
+MAX_TRIES=6
+settled() { [ -e "$STAMPS/$1.done" ] || [ -e "$STAMPS/$1.gave_up" ]; }
+
+# name | command | timeout.  Exit 0 = done (now or previously); exit 1 =
+# this attempt failed (caller decides whether it counts).
+run_step() {
+  local name=$1 cmd=$2 tmo=$3 rc=0
+  settled "$name" && return 0
+  say "step $name starting"
+  if timeout -k 30 "$tmo" bash -c "$cmd" >>"$LOG" 2>&1; then
+    touch "$STAMPS/$name.done"
+    say "step $name DONE"
+    return 0
+  else
+    rc=$?
+  fi
+  say "step $name failed (rc=$rc)"
+  return 1
+}
+
+record_fail() {
+  local name=$1 tries
+  echo x >>"$STAMPS/$name.tries"
+  tries=$(wc -l <"$STAMPS/$name.tries")
+  say "step $name failed with the tunnel up (attempt $tries/$MAX_TRIES)"
+  if [ "$tries" -ge "$MAX_TRIES" ]; then
+    touch "$STAMPS/$name.gave_up"
+    say "step $name gave up after $tries attempts"
+  fi
+}
+
+STEP_NAMES="bench mosaic_smoke measure_round4 measure_round5 baselines"
+# Headline first: a short tunnel window must yield the most important
+# artifact.  bench keeps its file contract (ONE parsed line) and only
+# stamps when the line really came from the chip.
+step_cmd() {
+  case $1 in
+    bench) echo "python bench.py >benchmarks/results/bench_r5_tpu.json \
+      && python - <<'PY'
 import json, sys
-rec = json.load(open("benchmarks/results/bench_r5_tpu.json"))
-sys.exit(0 if rec.get("platform") in ("tpu", "axon")
-         and rec.get("value") else 1)
-PY
-    then
-      say "measurement chain done (headline on TPU) — watchdog standing down"
+rec = json.load(open('benchmarks/results/bench_r5_tpu.json'))
+sys.exit(0 if rec.get('platform') in ('tpu', 'axon') and rec.get('value')
+         else 1)
+PY" ;;
+    mosaic_smoke)   echo "python benchmarks/mosaic_smoke.py" ;;
+    measure_round4) echo "python benchmarks/measure_round4.py" ;;
+    measure_round5) echo "python benchmarks/measure_round5.py" ;;
+    baselines)      echo "python benchmarks/run_baselines.py" ;;
+  esac
+}
+step_tmo() {
+  case $1 in
+    bench) echo 1800 ;; mosaic_smoke) echo 2400 ;;
+    measure_round4) echo 4800 ;; measure_round5) echo 3600 ;;
+    baselines) echo 4800 ;;
+  esac
+}
+
+say "watchdog v2 start (pid $$)"
+while true; do
+  if probe; then
+    say "tunnel UP — running unsettled steps"
+    for name in $STEP_NAMES; do
+      settled "$name" && continue
+      if ! run_step "$name" "$(step_cmd "$name")" "$(step_tmo "$name")"
+      then
+        # Charge the attempt only if the tunnel is STILL up (the
+        # failure was the step's own); a dead tunnel goes straight
+        # back to probe duty without burning the budget or the
+        # remaining steps' timeouts.
+        if probe; then record_fail "$name"; else break; fi
+      fi
+    done
+    # Stand down only when every step settled AND the headline really
+    # landed on the chip — bench parked as gave_up is NOT enough (the
+    # v1 invariant: no TPU headline, no stand-down).
+    all=1
+    for name in $STEP_NAMES; do settled "$name" || all=0; done
+    if [ "$all" = 1 ] && [ -e "$STAMPS/bench.done" ]; then
+      say "all steps settled — watchdog standing down"
       exit 0
     fi
-    say "chain ran but no TPU headline landed — resuming probes"
+  else
+    say "tunnel down"
   fi
-  say "tunnel down"
   sleep 90
 done

@@ -310,6 +310,42 @@ def _popcount_sum(words: jax.Array) -> jax.Array:
     return jnp.sum(jax.lax.population_count(words), dtype=jnp.int32)
 
 
+def _popcount_pair(words: jax.Array) -> jax.Array:
+    """Overflow-safe popcount total as an EXACT int32 pair [hi, lo]
+    (total = hi * 1024 + lo).  A flat int32 popcount sum wraps at 2^31 —
+    the 10M-peer x 256-message headline's 2.56e9 set bits came back as a
+    NEGATIVE coverage on hardware (round-5 measure_round4 crash).  Split
+    accounting: per-row totals (<= W*128*32 = 262k at W=64, exact) split
+    at 1024, so each partial sum stays far below 2^31 for any
+    configuration the engine admits (rows * 1023 <= 8e7 at 10M peers;
+    rows * 256 for hi).  The pair stays integer through any psum —
+    cross-shard sums are exact and order-invariant, preserving the
+    bitwise 1-vs-N parity contract — and only :func:`_pair_total` turns
+    it into one float32 at the very end."""
+    per_row = jnp.sum(jax.lax.population_count(words),
+                      axis=tuple(i for i in range(words.ndim)
+                                 if i != words.ndim - 2),
+                      dtype=jnp.int32)                       # [rows]
+    return jnp.stack([jnp.sum(per_row >> 10, dtype=jnp.int32),
+                      jnp.sum(per_row & 1023, dtype=jnp.int32)])
+
+
+def _pair_total(pair: jax.Array) -> jax.Array:
+    """float32 total from an (already reduced) [hi, lo] popcount pair.
+    One deterministic float op on exact ints — identical on every
+    sharding of the same global state (float32 carries 2.56e9 with
+    ~1e-7 relative error, far below any coverage threshold's needs)."""
+    return (pair[0].astype(jnp.float32) * 1024.0
+            + pair[1].astype(jnp.float32))
+
+
+def _pair_int(pair) -> int:
+    """EXACT Python-int total from a device_get [hi, lo] pair — the
+    host-side twin of :func:`_pair_total` (the 1024 split factor lives
+    only here and in _popcount_pair)."""
+    return int(pair[0]) * 1024 + int(pair[1])
+
+
 # ----------------------------------------------------------------------
 # Shard-invariant per-row randomness.  Every random decision is keyed on
 # the GLOBAL row id via fold_in, so a shard drawing only its own rows gets
@@ -384,6 +420,13 @@ class AlignedSimulator:
     #: its source in round m*k (messageGenerationLoop cadence,
     #: peer.cpp:357-377).  0 = every rumor exists from round 0.
     message_stagger: int = 0
+    #: fold the seen-update into the final gossip pass: the kernel turns
+    #: its VMEM-resident accumulator into (new, seen') directly, and in
+    #: pushpull the push pass's receive words seed the pull pass's
+    #: accumulator — the XLA elementwise read-recv/read-seen/write-new/
+    #: write-seen pass disappears (docs/PERFORMANCE.md "next factor").
+    #: Opt-in until the on-chip A/B lands, like block_perm before it.
+    fuse_update: bool = False
     seed: int = 0
     interpret: bool | None = None   # None -> interpret unless on TPU
 
@@ -418,25 +461,35 @@ class AlignedSimulator:
                 f"and an 8-aligned row block (this overlay: "
                 f"{self.topo.rows} rows, rowblk {self.topo.rowblk}) — "
                 "use the edge engine, a larger overlay, or fewer shards")
+        # The fused update keeps ~2x the word-blocks resident (seen +
+        # seen' + pushpull's accumulator seed alongside y and acc), so
+        # its VMEM budget is half the plain pass's.
+        budget = (MAX_WORDS_X_ROWBLK // 2 if self.fuse_update
+                  else MAX_WORDS_X_ROWBLK)
         if not self.interpret and \
-                self.n_words * self.topo.rowblk > MAX_WORDS_X_ROWBLK:
+                self.n_words * self.topo.rowblk > budget:
             # The kernel keeps int32[W, rowblk, 128] y/acc blocks resident
             # in VMEM; an over-budget combination compile-errors deep in
             # Mosaic.  Fail at construction with the fix spelled out —
             # and when no row block can help (build_aligned floors the
             # block at 8 sublanes), state the hard ceiling instead of
             # advising a rebuild that would fail the same way.
-            hard_cap = (MAX_WORDS_X_ROWBLK // 8) * WORD_BITS
-            if self.n_words * 8 > MAX_WORDS_X_ROWBLK:
+            hard_cap = (budget // 8) * WORD_BITS
+            if self.n_words * 8 > budget:
                 raise ValueError(
                     f"{self.n_msgs} messages exceed the aligned engine's "
                     f"hard ceiling of {hard_cap} (the VMEM row block "
-                    "bottoms out at 8 sublanes) — use the edge engine")
+                    "bottoms out at 8 sublanes"
+                    + (", halved budget under fuse_update) — drop "
+                       "fuse_update or use the edge engine"
+                       if self.fuse_update else ") — use the edge engine"))
+            fit_blk = max(8, budget // self.n_words // 8 * 8)
             raise ValueError(
                 f"{self.n_msgs} messages ({self.n_words} planes) with row "
                 f"block {self.topo.rowblk} exceed the kernel's VMEM "
-                f"budget — rebuild the overlay with build_aligned(..., "
-                f"n_msgs={self.n_msgs}) (shrinks the row block)")
+                f"budget{' (halved under fuse_update)' if self.fuse_update else ''}"
+                f" — rebuild the overlay with build_aligned(..., "
+                f"n_msgs={self.n_msgs}, rowblk={fit_blk})")
         self._n_honest = (self.n_honest_msgs
                           if self.n_honest_msgs is not None else self.n_msgs)
         if not 0 < self._n_honest <= self.n_msgs:
@@ -493,11 +546,22 @@ class AlignedSimulator:
                 n_msgs = MAX_CONFIG_MSGS - n_junk
             n_honest = n_msgs
             n_msgs = n_msgs + n_junk
-        # n_msgs shrinks the kernel's VMEM row block for wide message sets
+        # n_msgs shrinks the kernel's VMEM row block for wide message
+        # sets; the fused update keeps twice the word-blocks resident,
+        # so its row block is bounded by the HALVED budget directly
+        # (doubling n_msgs instead under-shrinks whenever n_msg_words(2m)
+        # lands at 2w-1 — e.g. 129 messages: 258 msgs -> 9 words ->
+        # rowblk 448, but 5 words x 448 busts the 2048 budget).
+        rowblk = 512
+        if cfg.fuse_update:
+            rowblk = min(512, max(
+                8, (MAX_WORDS_X_ROWBLK // 2) // n_msg_words(n_msgs)
+                // 8 * 8))
         topo = build_aligned(seed=cfg.prng_seed, n=n, n_slots=n_slots,
                              degree_law=law,
                              powerlaw_alpha=cfg.powerlaw_alpha,
                              n_shards=n_shards, n_msgs=n_msgs,
+                             rowblk=rowblk,
                              roll_groups=cfg.roll_groups or None,
                              block_perm=bool(cfg.block_perm))
         return cls(topo=topo, n_msgs=n_msgs, mode=cfg.mode,
@@ -518,6 +582,7 @@ class AlignedSimulator:
                           if cfg.get_message_interval() > 0
                           else cfg.get_ping_interval()))),
                    message_stagger=cfg.message_stagger,
+                   fuse_update=bool(cfg.fuse_update),
                    seed=cfg.prng_seed)
 
     # ------------------------------------------------------------------
@@ -572,7 +637,21 @@ class AlignedSimulator:
                         + 2 * slot8               # evict8 write + reduce
                         + (plane if fused else 3 * plane))  # gather/prep
             total += liveness // self.liveness_every
-        total += 4 * word_planes                  # seen|new update + metrics
+        # Post-pass state update + metric reductions.  Metrics read the
+        # fresh ``new`` (deliveries popcount) and ``seen`` (coverage
+        # popcount) planes either way.
+        metrics = 2 * word_planes
+        if self.fuse_update:
+            # In-kernel: the final pass streams seen in + seen' out and
+            # the rmask plane; pushpull re-reads the push receive as the
+            # pull accumulator seed.  No XLA elementwise update exists.
+            total += 2 * word_planes + plane + metrics
+            if self.mode == "pushpull":
+                total += word_planes
+        else:
+            # XLA elementwise update: read each pass's receive words,
+            # read seen, write new + seen'.
+            total += (n_passes + 3) * word_planes + metrics
         return int(total)
 
     # ------------------------------------------------------------------
@@ -739,7 +818,7 @@ def aligned_coverage(sim: AlignedSimulator, state: AlignedState,
     alive_w = jnp.where(state.alive_b, jnp.int32(-1), jnp.int32(0))
     ok_w = alive_w & ~state.byz_w & topo.valid_w
     n_ok = max(int(jax.device_get(_popcount_sum(ok_w))) >> 5, 1)
-    hits = int(jax.device_get(_popcount_sum(
+    hits = _pair_int(jax.device_get(_popcount_pair(   # exact >2^31 bits
         state.seen_w & ok_w[None] & sim._honest_mask[:, None, None])))
     n_cols = sim._n_honest
     if sim.message_stagger > 0:
@@ -905,6 +984,14 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
         # the in-kernel send mask: -1 where the source is alive and
         # honest (dead peers don't send; byzantine peers never relay)
         src_ok = gather(alive_w & ~state.byz_w)
+    # In-kernel seen-update (sim.fuse_update): the FINAL pass of the
+    # round takes the receiver's seen planes + receive mask and emits
+    # (new, seen') straight from its VMEM-resident accumulator; in
+    # pushpull the push receive seeds the pull accumulator.  Dead peers
+    # don't receive either way (the link is gone — gossip.py:_advance).
+    fin = sim.fuse_update
+    rmask_w = (topo.valid_w & alive_w) if fin else None
+    new = seen = None
     if sim.mode in ("push", "pushpull"):
         # Dead peers don't send; byzantine peers never relay (suppression,
         # models/gossip.py:50-58) — both masked at the source words.
@@ -922,14 +1009,19 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
             shift = (u % jnp.maximum(deg32, 1)).astype(jnp.int8)
         else:
             shift = None
+        push_final = fin and sim.mode == "push"
         recv = gossip_pass(y, topo.colidx, topo.deg, rolls_off,
                            topo.subrolls, pull=False, fanout=sim.fanout,
                            shift=shift,
                            ytab=ytab_local if fused else None,
                            src_ok=src_ok if fused else None,
+                           seen=seen_w if push_final else None,
+                           rmask=rmask_w if push_final else None,
                            rowblk=topo.rowblk,
                            interpret=sim.interpret)
-    else:                       # pure anti-entropy pull
+        if push_final:
+            new, seen = recv
+    elif not fin:               # pure anti-entropy pull
         recv = jnp.zeros_like(seen_w)
     if sim.mode in ("pull", "pushpull"):
         # Anti-entropy: each peer pulls one random slot's neighbor's
@@ -945,21 +1037,32 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
         delta = (u % jnp.maximum(deg32, 1)).astype(jnp.int8)
         delta = jnp.where(deg32 > 0, delta,
                           jnp.int8(topo.n_slots))      # no contact
-        recv = recv | gossip_pass(ys, topo.colidx, delta, rolls_off,
-                                  topo.subrolls, pull=True,
-                                  ytab=ytab_local if fused else None,
-                                  src_ok=src_ok if fused else None,
-                                  rowblk=topo.rowblk,
-                                  interpret=sim.interpret)
+        pulled = gossip_pass(ys, topo.colidx, delta, rolls_off,
+                             topo.subrolls, pull=True,
+                             ytab=ytab_local if fused else None,
+                             src_ok=src_ok if fused else None,
+                             acc_init=(recv if fin and
+                                       sim.mode == "pushpull" else None),
+                             seen=seen_w if fin else None,
+                             rmask=rmask_w,
+                             rowblk=topo.rowblk,
+                             interpret=sim.interpret)
+        if fin:
+            new, seen = pulled
+        else:
+            recv = recv | pulled
 
-    # Dead peers don't receive (the link is gone — gossip.py:_advance).
-    recv = recv & topo.valid_w[None] & alive_w[None]
-    new = recv & ~seen_w
-    seen = seen_w | new
+    if not fin:
+        recv = recv & topo.valid_w[None] & alive_w[None]
+        new = recv & ~seen_w
+        seen = seen_w | new
     # In this engine deliveries == frontier bits by construction (every
     # first receipt enters the next frontier); both keys are kept for
-    # surface parity with sim.Simulator's metric dict.
-    deliveries = msg_reduce(_popcount_sum(new))
+    # surface parity with sim.Simulator's metric dict.  Totals ride the
+    # exact [hi, lo] int pair through the cross-shard reduction (a flat
+    # int32 popcount wraps at the 10M x 256 scale) and become one
+    # float32 only after it — bitwise-identical on every sharding.
+    deliveries = _pair_total(msg_reduce(_popcount_pair(new)))
     # Coverage over honest columns of LIVE HONEST peers — the edge
     # engine's coverage_of (sim.py:33-43).  Each ok peer contributes 32
     # bits to popcount(ok_w), hence the >> 5 peer count.
@@ -984,9 +1087,8 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
             1).astype(jnp.float32)
     else:
         n_cols = jnp.float32(sim._n_honest)
-    coverage = (msg_reduce(_popcount_sum(
-        seen & ok_w[None] & hmask[:, None, None]))
-                .astype(jnp.float32)
+    coverage = (_pair_total(msg_reduce(_popcount_pair(
+        seen & ok_w[None] & hmask[:, None, None])))
                 / (n_ok.astype(jnp.float32) * n_cols))
     live = reduce(_popcount_sum(alive_w & topo.valid_w)) >> 5
     state = AlignedState(seen_w=seen, frontier_w=new, alive_b=alive_b,

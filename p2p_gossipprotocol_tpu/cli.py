@@ -219,10 +219,13 @@ def _report(res, sim, *, n_peers, engine, args, metrics_lib, clamps=None,
     (graph.py:from_config — numpy PCG vs native SplitMix64)."""
     if not args.quiet:
         for i in range(len(res.coverage)):
+            # frontier/deliveries arrive as float32 from the aligned
+            # engines (the exact popcount pair combines to float so
+            # totals past 2^31 bits don't wrap) — render as ints
             print(f"round {i + 1:4d}  coverage={res.coverage[i]:.4f}  "
-                  f"frontier={res.frontier_size[i]:8d}  "
-                  f"live={res.live_peers[i]:8d}  "
-                  f"evictions={res.evictions[i]:6d}")
+                  f"frontier={int(res.frontier_size[i]):8d}  "
+                  f"live={int(res.live_peers[i]):8d}  "
+                  f"evictions={int(res.evictions[i]):6d}")
             if res.coverage[i] >= 0.999999 and res.frontier_size[i] == 0:
                 break
     if args.metrics_jsonl:

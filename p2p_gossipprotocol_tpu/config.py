@@ -108,6 +108,10 @@ _SIM_INT_KEYS = {
     # rides the kernels' index table, eliminating the per-pass
     # permute/mask prep entirely (build_aligned(block_perm=True)).
     "block_perm": "block_perm",
+    # aligned engine: 1 = fold the seen-update into the final gossip
+    # pass (the kernel emits (new, seen') from its resident accumulator
+    # — aligned.AlignedSimulator.fuse_update).
+    "fuse_update": "fuse_update",
     "rounds": "rounds",
     "prng_seed": "prng_seed",
     # jax backend: rounds between successive message activations —
@@ -179,6 +183,7 @@ class NetworkConfig:
         self.fanout = 0
         self.roll_groups = 0           # aligned engine; 0 = per-slot rolls
         self.block_perm = 0            # aligned engine; 1 = fused overlay
+        self.fuse_update = 0           # aligned engine; 1 = in-kernel seen|new
         self.rounds = 0
         self.message_stagger = 0       # 0 = all rumors at round 0
         self.mesh_devices = 0          # 0/1 = single device
@@ -305,8 +310,8 @@ class NetworkConfig:
         if not is_valid_port(self.local_port):
             raise ConfigError(f"Invalid local_port: {self.local_port}")
         for k in ("n_peers", "n_messages", "avg_degree", "ba_m", "fanout",
-                  "roll_groups", "block_perm", "rounds", "prng_seed",
-                  "anti_entropy_interval", "message_stagger",
+                  "roll_groups", "block_perm", "fuse_update", "rounds",
+                  "prng_seed", "anti_entropy_interval", "message_stagger",
                   "mesh_devices", "msg_shards"):
             if getattr(self, k) < 0:
                 raise ConfigError(f"{k} must be non-negative")

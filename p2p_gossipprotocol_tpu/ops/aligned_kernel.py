@@ -56,7 +56,7 @@ LANES = 128
 
 
 def _pass_kernel(pull: bool, n_planes: int, fanout: int, masked: bool,
-                 n_pref: int, *refs):
+                 has_init: bool, finalize: bool, n_pref: int, *refs):
     pref, rest = refs[:n_pref], refs[n_pref:]
     subrolls_ref = pref[1]        # pref[0]=rolls, pref[2]=ytab (fused)
     y_ref, col_ref, gate_ref = rest[0], rest[1], rest[2]
@@ -72,7 +72,22 @@ def _pass_kernel(pull: bool, n_planes: int, fanout: int, masked: bool,
     if fanout > 0 and not pull:
         shift_ref = rest[i]
         i += 1
+    if has_init:
+        # Pushpull chaining: the push pass's receive words seed the
+        # accumulator, so the two passes' combine never round-trips HBM.
+        init_ref = rest[i]
+        i += 1
+    if finalize:
+        # In-kernel seen-update: the receiver's seen planes + receive
+        # mask ride in once per row block (d-constant index maps); the
+        # last slot turns the resident accumulator into (new, seen')
+        # directly — the XLA-side read-recv/read-seen/write-new/
+        # write-seen elementwise pass disappears.
+        seen_ref, rmask_ref = rest[i], rest[i + 1]
+        i += 2
     acc_ref = rest[i]
+    if finalize:
+        seen_out_ref = rest[i + 1]
     d = pl.program_id(1)
     # Per-slot sublane roll: out-row i reads y-row (i + s_d) % blk, so a
     # peer's D slots see D distinct source rows even when the grid has a
@@ -100,6 +115,7 @@ def _pass_kernel(pull: bool, n_planes: int, fanout: int, masked: bool,
             col, axis=1)
     # Static unroll over message planes: col/gate/ok stay resident, each
     # plane costs one sublane roll + one lane-wise dynamic_gather.
+    n_slots = pl.num_programs(1)
     for w in range(n_planes):
         y = pltpu.roll(y_ref[w], blk - subrolls_ref[d], axis=0)
         zw = jnp.take_along_axis(y, col, axis=1)
@@ -109,11 +125,18 @@ def _pass_kernel(pull: bool, n_planes: int, fanout: int, masked: bool,
 
         @pl.when(d == 0)
         def _(w=w, z=z):
-            acc_ref[w] = z
+            acc_ref[w] = (init_ref[w] | z) if has_init else z
 
         @pl.when(d > 0)
         def _(w=w, z=z):
             acc_ref[w] = acc_ref[w] | z
+
+        if finalize:
+            @pl.when(d == n_slots - 1)
+            def _(w=w):
+                new = acc_ref[w] & rmask_ref[:] & ~seen_ref[w]
+                acc_ref[w] = new
+                seen_out_ref[w] = seen_ref[w] | new
 
 
 def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
@@ -121,8 +144,11 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
                 pull: bool = False, fanout: int = 0,
                 shift: jax.Array | None = None,
                 ytab: jax.Array | None = None,
-                src_ok: jax.Array | None = None, rowblk: int = 512,
-                interpret: bool = False) -> jax.Array:
+                src_ok: jax.Array | None = None,
+                acc_init: jax.Array | None = None,
+                seen: jax.Array | None = None,
+                rmask: jax.Array | None = None, rowblk: int = 512,
+                interpret: bool = False):
     """One OR-accumulated D-slot pass over W message planes.
 
     ``y``       int32[W, Ry, 128] — packed sender words.  Legacy layout:
@@ -152,7 +178,19 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
     ``fanout``/``shift`` — bounded fanout (push only): listen on the
                 fanout-slot circular window starting at ``shift`` (int8
                 [R, 128], per-round random in [0, deg)); fanout=0 floods
-    Returns int32[W, R, 128]: words each peer hears this pass.
+    ``acc_init`` int32[W, R, 128] — OPTIONAL accumulator seed: a prior
+                pass's receive words OR into slot 0's contribution, so a
+                pushpull round's combine never round-trips HBM
+    ``seen``/``rmask`` — OPTIONAL in-kernel seen-update: ``seen`` is the
+                receiver's packed seen planes (int32[W, R, 128]),
+                ``rmask`` the receive mask (int32[R, 128], -1 where the
+                receiver is valid & alive).  The final slot turns the
+                VMEM-resident accumulator into the delta directly:
+                ``new = acc & rmask & ~seen`` and ``seen' = seen | new``
+                — replacing the XLA elementwise update (the traffic
+                model's seen|new term).
+    Returns int32[W, R, 128]: words each peer hears this pass — or the
+    pair ``(new, seen')`` when ``seen`` is given.
     """
     W, Ry, C = y.shape
     assert C == LANES, f"lane dim must be {LANES}, got {C}"
@@ -163,6 +201,9 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
     Ty = Ry // blk        # y (possibly global) row blocks
     fanout = 0 if pull else fanout
     fused = ytab is not None
+    finalize = seen is not None
+    if finalize:
+        assert rmask is not None, "in-kernel seen-update needs rmask"
     if fused:
         assert src_ok is not None, "block-perm pass needs the src_ok mask"
         assert ytab.shape == (D, T), (ytab.shape, (D, T))
@@ -191,21 +232,40 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
         assert shift is not None, "bounded fanout needs a shift plane"
         in_specs.append(pl.BlockSpec((blk, C), row_map))
         operands.append(shift)
+    # d-constant index maps: these blocks load once per row block and
+    # stay resident across the slot loop, exactly like the accumulator.
+    acc_map = ((lambda t, d, k, s, yt: (0, t, 0)) if fused
+               else (lambda t, d, k, s: (0, t, 0)))
+    if acc_init is not None:
+        in_specs.append(pl.BlockSpec((W, blk, C), acc_map))
+        operands.append(acc_init)
+    if finalize:
+        in_specs.append(pl.BlockSpec((W, blk, C), acc_map))
+        operands.append(seen)
+        in_specs.append(pl.BlockSpec((blk, C), row_map))
+        operands.append(rmask)
+        out_specs = [pl.BlockSpec((W, blk, C), acc_map),
+                     pl.BlockSpec((W, blk, C), acc_map)]
+        out_shape = [jax.ShapeDtypeStruct((W, R, C), jnp.int32),
+                     jax.ShapeDtypeStruct((W, R, C), jnp.int32)]
+    else:
+        out_specs = pl.BlockSpec((W, blk, C), acc_map)
+        out_shape = jax.ShapeDtypeStruct((W, R, C), jnp.int32)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=n_pref,
         grid=(T, D),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((W, blk, C),
-                               (lambda t, d, k, s, yt: (0, t, 0)) if fused
-                               else (lambda t, d, k, s: (0, t, 0))),
+        out_specs=out_specs,
     )
-    return pl.pallas_call(
-        functools.partial(_pass_kernel, pull, W, fanout, fused, n_pref),
+    out = pl.pallas_call(
+        functools.partial(_pass_kernel, pull, W, fanout, fused,
+                          acc_init is not None, finalize, n_pref),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((W, R, C), jnp.int32),
+        out_shape=out_shape,
         interpret=interpret,
     )(*prefetch, *operands)
+    return tuple(out) if finalize else out
 
 
 def _count_kernel(rolls_ref, subrolls_ref, y_ref, col_ref, gate_ref,

@@ -86,6 +86,7 @@ class AlignedShardedSimulator:
     max_strikes: int = 3
     liveness_every: int = 1
     message_stagger: int = 0
+    fuse_update: bool = False
     seed: int = 0
     interpret: bool | None = None
 
@@ -108,6 +109,7 @@ class AlignedShardedSimulator:
             n_honest_msgs=self.n_honest_msgs, max_strikes=self.max_strikes,
             liveness_every=self.liveness_every,
             message_stagger=self.message_stagger,
+            fuse_update=self.fuse_update,
             seed=self.seed, interpret=self.interpret)
         self.churn = self._inner.churn
         self.interpret = self._inner.interpret

@@ -81,10 +81,10 @@ class SimResult(_FromMetrics):
     state: GossipState
     topo: Topology
     coverage: np.ndarray       # float32[rounds]
-    deliveries: np.ndarray     # int32[rounds]
-    frontier_size: np.ndarray  # int32[rounds]
-    live_peers: np.ndarray     # int32[rounds]
-    evictions: np.ndarray      # int32[rounds]
+    deliveries: np.ndarray     # int32[rounds] (edge engine); float32 from
+    frontier_size: np.ndarray  #   the aligned engines — exact popcount
+    live_peers: np.ndarray     #   pairs combine to float so totals past
+    evictions: np.ndarray      #   2^31 bits don't wrap (aligned.py)
     wall_s: float = 0.0
 
     def rounds_to(self, target: float = 0.99) -> int:

@@ -1,0 +1,149 @@
+"""In-kernel seen-update (round-5 ``fuse_update``) and the
+overflow-safe popcount pair.
+
+fuse_update folds the XLA elementwise state update (``new = recv & mask
+& ~seen; seen |= new``) into the final gossip pass: the kernel's
+VMEM-resident accumulator finalizes into ``(new, seen')`` directly, and
+in pushpull the push pass's receive words seed the pull pass's
+accumulator (``acc_init``).  The contract is BITWISE identity with the
+unfused engine on every mode, overlay family, and sharding — same
+discipline as block_perm before it (tests/test_block_perm.py).
+
+The popcount pair (`_popcount_pair`/`_pair_total`) exists because a flat
+int32 popcount sum wraps above 2^31 set bits — the 10M-peer x
+256-message headline returned a NEGATIVE coverage on hardware
+(benchmarks/results/watchdog_r5.log, round-5 measure_round4 crash).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator,
+                                            _pair_total, _popcount_pair,
+                                            build_aligned)
+from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+
+
+def _mk(bp, mode, fuse, **over):
+    topo = build_aligned(seed=3, n=1024, n_slots=8,
+                         degree_law="powerlaw", roll_groups=2, rowblk=8,
+                         block_perm=bp)
+    kw = dict(topo=topo, n_msgs=40, mode=mode,
+              churn=ChurnConfig(rate=0.05, kill_round=1), max_strikes=3,
+              liveness_every=2, byzantine_fraction=0.1, n_honest_msgs=32,
+              message_stagger=1, fuse_update=fuse, seed=5)
+    kw.update(over)
+    return AlignedSimulator(**kw)
+
+
+def _assert_bitwise(ra, rb, ctx):
+    for f in ("coverage", "deliveries", "live_peers", "evictions"):
+        np.testing.assert_array_equal(np.asarray(getattr(ra, f)),
+                                      np.asarray(getattr(rb, f)),
+                                      err_msg=f"{ctx}:{f}")
+    np.testing.assert_array_equal(np.asarray(ra.state.seen_w),
+                                  np.asarray(rb.state.seen_w),
+                                  err_msg=f"{ctx}:seen_w")
+
+
+@pytest.mark.parametrize("bp", [False, True])
+@pytest.mark.parametrize("mode", ["push", "pull", "pushpull"])
+def test_fuse_update_bitwise_parity(bp, mode):
+    """Fused == unfused, bit for bit, under churn + liveness + byzantine
+    + staggered generation, on both overlay families."""
+    ra = _mk(bp, mode, False).run(6)
+    rb = _mk(bp, mode, True).run(6)
+    _assert_bitwise(ra, rb, f"bp={bp} mode={mode}")
+
+
+def test_fuse_update_sharded_parity(devices8):
+    """The sharded engines inherit the fused path through the shared
+    aligned_round; 1-D mesh and 2-D (msgs x peers) mesh both stay
+    bitwise-identical to the unsharded fused run."""
+    from p2p_gossipprotocol_tpu.parallel import (Aligned2DShardedSimulator,
+                                                 AlignedShardedSimulator,
+                                                 make_mesh, make_mesh_2d)
+
+    topo = build_aligned(seed=3, n=8192, n_slots=8,
+                         degree_law="powerlaw", roll_groups=2, n_shards=8)
+    kw = dict(topo=topo, n_msgs=64, mode="pushpull",
+              churn=ChurnConfig(rate=0.05, kill_round=1), max_strikes=3,
+              liveness_every=2, fuse_update=True, seed=5)
+    base = AlignedSimulator(**kw).run(4)
+    sh = AlignedShardedSimulator(mesh=make_mesh(8), **kw).run(4)
+    _assert_bitwise(base, sh, "1d-sharded")
+    sh2 = Aligned2DShardedSimulator(mesh=make_mesh_2d(2, 4), **kw).run(4)
+    _assert_bitwise(base, sh2, "2d-mesh")
+
+
+def test_fuse_update_model_bytes_drop():
+    """The traffic model charges the fused update less than the XLA
+    elementwise update in every mode (the whole point of the fusion)."""
+    for mode in ("push", "pull", "pushpull"):
+        legacy = _mk(False, mode, False).hbm_bytes_per_round()
+        fused = _mk(False, mode, True).hbm_bytes_per_round()
+        assert fused < legacy, (mode, fused, legacy)
+
+
+def test_fuse_update_vmem_budget_halved():
+    """On TPU the fused pass keeps ~2x the word-blocks resident, so the
+    W * rowblk budget is halved; an overlay that fits the plain pass but
+    not the fused one must be rejected at construction (the
+    never-silently-weaken discipline), with the doubled-n_msgs rebuild
+    hint."""
+    topo = build_aligned(seed=0, n=1 << 16, n_slots=4, n_msgs=256)
+    sim = AlignedSimulator(topo=topo, n_msgs=256, mode="push", seed=0,
+                           interpret=False)     # plain pass: fits
+    assert sim.n_words * topo.rowblk * 2 > 4096  # would bust fused budget
+    with pytest.raises(ValueError, match="fuse_update"):
+        AlignedSimulator(topo=topo, n_msgs=256, mode="push", seed=0,
+                         fuse_update=True, interpret=False)
+
+
+def test_fuse_update_config_key(tmp_path):
+    """fuse_update reaches the engine from a config file alone, and
+    from_config sizes the row block for the halved budget — asserted at
+    a scale where the sizing rule actually bites (W=8 planes, >= 1024
+    rows: plain sizing gives rowblk 512, fused must halve it)."""
+    from p2p_gossipprotocol_tpu.config import NetworkConfig
+
+    base = ("10.0.0.1:9000\nbackend=jax\nengine=aligned\n"
+            "n_peers=131072\nn_messages=256\nmode=pushpull\n")
+    fused_p, plain_p = tmp_path / "fused.txt", tmp_path / "plain.txt"
+    fused_p.write_text(base + "fuse_update=1\n")
+    plain_p.write_text(base)
+    cfg = NetworkConfig(str(fused_p))
+    assert cfg.fuse_update == 1
+    sim = AlignedSimulator.from_config(cfg)
+    assert sim.fuse_update is True
+    plain = AlignedSimulator.from_config(NetworkConfig(str(plain_p)))
+    assert plain.fuse_update is False
+    # fused row block sized as if the planes were twice as wide
+    assert sim.topo.rowblk * sim.n_words * 2 <= 4096
+    assert sim.topo.rowblk == plain.topo.rowblk // 2
+
+
+def test_popcount_pair_exceeds_int32():
+    """> 2^31 set bits: the flat int32 sum wraps negative; the pair stays
+    exact.  (Shape sized to 2.4e9 bits — the smallest that crosses.)"""
+    words = jnp.full((72, 8192, 128), -1, jnp.int32)
+    total_bits = 72 * 8192 * 128 * 32
+    assert total_bits > 2**31
+    pair = jax.device_get(_popcount_pair(words))
+    assert int(pair[0]) * 1024 + int(pair[1]) == total_bits
+    # the float32 combine carries it to ~1e-7 relative error
+    f = float(jax.device_get(_pair_total(jnp.asarray(pair))))
+    assert abs(f - total_bits) / total_bits < 1e-6
+
+
+def test_popcount_pair_matches_numpy_random():
+    rng = np.random.default_rng(0)
+    words = jnp.asarray(rng.integers(np.iinfo(np.int32).min,
+                                     np.iinfo(np.int32).max,
+                                     size=(3, 64, 128), dtype=np.int32))
+    expect = int(np.unpackbits(
+        np.asarray(words).view(np.uint8)).sum())
+    pair = jax.device_get(_popcount_pair(words))
+    assert int(pair[0]) * 1024 + int(pair[1]) == expect
