@@ -140,6 +140,9 @@ def _bench_aligned(n, n_msgs, degree, mode):
     # Block-perm overlay (fused kernels, zero per-pass prep) — opt-in
     # until the on-chip A/B lands.
     block_perm = bool(int(os.environ.get("GOSSIP_BENCH_BLOCK_PERM", "0")))
+    # In-kernel seen-update / windowed pull — same opt-in discipline.
+    fuse_update = bool(int(os.environ.get("GOSSIP_BENCH_FUSE_UPDATE", "0")))
+    pull_window = bool(int(os.environ.get("GOSSIP_BENCH_PULL_WINDOW", "0")))
     t0 = time.perf_counter()
     topo = build_aligned(seed=0, n=n, n_slots=degree,
                          degree_law="powerlaw", roll_groups=roll_groups,
@@ -149,6 +152,7 @@ def _bench_aligned(n, n_msgs, degree, mode):
                            churn=ChurnConfig(rate=churn_rate, kill_round=1),
                            max_strikes=3, liveness_every=liveness_every,
                            message_stagger=stagger,
+                           fuse_update=fuse_update, pull_window=pull_window,
                            seed=0)
     state, topo2, rounds, wall = sim.run_to_coverage(target=TARGET_COV,
                                                      max_rounds=MAX_ROUNDS)
@@ -163,6 +167,8 @@ def _bench_aligned(n, n_msgs, degree, mode):
         "roll_groups": roll_groups,
         **({"message_stagger": stagger} if stagger else {}),
         **({"block_perm": True} if block_perm else {}),
+        **({"fuse_update": True} if fuse_update else {}),
+        **({"pull_window": True} if pull_window else {}),
         # analytic traffic model (aligned.hbm_bytes_per_round) vs the
         # measured wall: how close the engine runs to the ~800 GB/s
         # v5e HBM roof — the round-3 judge's "quantify the gap" ask

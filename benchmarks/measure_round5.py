@@ -227,6 +227,42 @@ def bench_fuse_update_ab(n=1 << 20):
                       1)})
 
 
+def bench_pull_window_ab(n=1 << 20):
+    """Windowed pull vs full-width pull at 1M x 16 and 1M x 256
+    (pushpull, churned): model says the pull pass's seen-plane stream
+    drops from `streams` to 1 and its lane table by D/window — -8% at
+    fused-2, -13% at legacy-4 (docs/PERFORMANCE.md).  Also reports
+    rounds-to-99 so the convergence cost (if any) is measured, not
+    assumed."""
+    from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator,
+                                                aligned_coverage,
+                                                build_aligned)
+    from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+
+    for n_msgs, bp, groups in ((16, False, 4), (256, True, 2)):
+        topo = build_aligned(seed=7, n=n, n_slots=16,
+                             degree_law="powerlaw", roll_groups=groups,
+                             n_msgs=n_msgs, block_perm=bp)
+        for pw in (False, True):
+            sim = AlignedSimulator(
+                topo=topo, n_msgs=n_msgs, mode="pushpull",
+                churn=ChurnConfig(rate=0.05, kill_round=1),
+                max_strikes=3, liveness_every=3, pull_window=pw, seed=1)
+            state, topo2, rounds, wall = sim.run_to_coverage(
+                target=0.99, max_rounds=64)
+            cov = aligned_coverage(sim, state, topo2)
+            emit({"config": (f"1m_{n_msgs}msg_bp{int(bp)}_g{groups}"
+                             f"_pullwin_{int(pw)}"),
+                  "n_peers": n, "n_msgs": n_msgs, "block_perm": bp,
+                  "roll_groups": groups, "pull_window": pw,
+                  "rounds": rounds, "wall_s": round(wall, 4),
+                  "ms_per_round": round(wall / max(rounds, 1) * 1000, 3),
+                  "final_coverage": round(cov, 5),
+                  "bytes_per_round": sim.hbm_bytes_per_round(),
+                  "achieved_gb_s": round(
+                      sim.hbm_bytes_per_round() * rounds / wall / 1e9, 1)})
+
+
 def bench_stagger_ab(n=1 << 20):
     from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator,
                                                 aligned_coverage,
@@ -263,6 +299,7 @@ def main():
     bench_roll_group_reuse()
     bench_block_perm_ab()
     bench_fuse_update_ab()
+    bench_pull_window_ab()
     bench_stagger_ab()
     return 0
 

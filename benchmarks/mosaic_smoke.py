@@ -173,6 +173,15 @@ def main():
             churn=ChurnConfig(rate=0.05, kill_round=1), max_strikes=2,
             liveness_every=2, seed=1, interpret=interp)) and None))
 
+    # 6e) windowed pull (round-5 pull_window): the pull pass on a
+    #     window-sized grid, composed with fuse_update
+    results.append(_check("pull_window", lambda: _run_pair(
+        lambda interp: AlignedSimulator(
+            topo=topo_rg, n_msgs=64, mode="pushpull", pull_window=True,
+            fuse_update=True,
+            churn=ChurnConfig(rate=0.05, kill_round=1), max_strikes=2,
+            liveness_every=3, seed=1, interpret=interp)) and None))
+
     # 7) SIR count_pass
     def sir_pair():
         def mk(interp):

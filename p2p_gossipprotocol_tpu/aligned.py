@@ -427,6 +427,16 @@ class AlignedSimulator:
     #: write-seen pass disappears (docs/PERFORMANCE.md "next factor").
     #: Opt-in until the on-chip A/B lands, like block_perm before it.
     fuse_update: bool = False
+    #: restrict the pull contact draw to the FIRST roll group's slots
+    #: (uniform over [0, min(deg, window)) — still one uniformly-random
+    #: in-neighbor, since slot identities are i.i.d.).  The pull pass
+    #: then runs a window-sized grid whose slots all share ONE block
+    #: roll, cutting its seen-plane stream from `streams` to 1 and its
+    #: lane-table stream by D/window (docs/PERFORMANCE.md "pull-window
+    #: restriction").  Needs a roll-grouped overlay (window >= 2).
+    #: Opt-in: it changes every pull trajectory (different draw
+    #: modulus), so it is an A/B knob, not a default.
+    pull_window: bool = False
     seed: int = 0
     interpret: bool | None = None   # None -> interpret unless on TPU
 
@@ -494,6 +504,39 @@ class AlignedSimulator:
                           if self.n_honest_msgs is not None else self.n_msgs)
         if not 0 < self._n_honest <= self.n_msgs:
             raise ValueError("n_honest_msgs must be in (0, n_msgs]")
+        # Pull-window slot count: the first contiguous run of equal
+        # block rolls (static per topology).  Without pull_window the
+        # window is all slots — the unified pull path below then draws
+        # and streams exactly what it always did.
+        if self.pull_window:
+            rolls_np = np.asarray(self.topo.rolls)
+            changes = np.nonzero(np.diff(rolls_np))[0]
+            self._pull_slots = (int(changes[0]) + 1 if changes.size
+                                else self.topo.n_slots)
+            if self._pull_slots < 2:
+                # window 1 = every peer pulls the SAME neighbor every
+                # round (colidx is static) — anti-entropy degenerates.
+                raise ValueError(
+                    "pull_window needs a roll-grouped overlay whose "
+                    "first group spans >= 2 slots (build_aligned("
+                    "roll_groups=g) with g <= n_slots/2)")
+            if self.mode == "push":
+                raise ValueError("pull_window only affects pull/"
+                                 "pushpull modes")
+            if self.mode == "pull" and self.topo.ytab is not None:
+                # Pure pull restricted to ONE shared block roll on a
+                # block-perm overlay: the pull-level block graph is a
+                # permutation cycle (out-degree 1) — the same stall
+                # build_aligned rejects for block_perm + roll_groups=1
+                # — and anti-entropy plateaus at the cycle-reachable
+                # fraction.  pushpull is fine (the push pass still
+                # mixes across all rolls).
+                raise ValueError(
+                    "pull_window with mode=pull on a block_perm "
+                    "overlay confines anti-entropy to a single block "
+                    "cycle — use pushpull, or a row-perm overlay")
+        else:
+            self._pull_slots = self.topo.n_slots
         # Liveness (strikes/rewire) runs whenever peers can die — without
         # churn no neighbor is ever observed dead, so the pass is skipped
         # statically and the strike plane is never allocated.
@@ -583,6 +626,7 @@ class AlignedSimulator:
                           else cfg.get_ping_interval()))),
                    message_stagger=cfg.message_stagger,
                    fuse_update=bool(cfg.fuse_update),
+                   pull_window=bool(cfg.pull_window),
                    seed=cfg.prng_seed)
 
     # ------------------------------------------------------------------
@@ -614,21 +658,37 @@ class AlignedSimulator:
         y_streams = int(1 + (np.diff(rolls) != 0).sum()) if D > 1 else 1
 
         fused = self.topo.ytab is not None
-        gossip_pass_bytes = (y_streams * word_planes  # y per distinct roll
-                             + slot8              # colidx
-                             + R * LANES          # gate
-                             + word_planes)       # OR-accumulator out
-        if fused:
-            # block-perm overlay: NO host-side permute/mask pass — the
-            # kernel reads raw state planes through the ytab index
-            # table; the cost is the src_ok mask plane streamed per
-            # distinct roll instead
-            prep = 0
-            gossip_pass_bytes += y_streams * plane
+
+        def pass_bytes(streams, n_slots_d):
+            b = (streams * word_planes    # y per distinct roll
+                 + n_slots_d * R * LANES  # colidx rows the grid visits
+                 + R * LANES              # gate
+                 + word_planes)           # OR-accumulator out
+            if fused:
+                # block-perm overlay: NO host-side permute/mask pass —
+                # the kernel reads raw state planes through the ytab
+                # index table; the cost is the src_ok mask plane
+                # streamed per distinct roll instead
+                b += streams * plane
+            return b
+
+        prep = 0 if fused else 3 * word_planes    # mask + permute gather
+        # Pull-window: the pull pass runs a window-sized grid whose
+        # slots share one block roll — one seen-plane stream, and only
+        # the window's colidx rows.
+        pull_streams = (1 if self.pull_window else y_streams)
+        pull_slots = self._pull_slots
+        if self.mode == "pushpull":
+            total = pass_bytes(y_streams, D) + pass_bytes(pull_streams,
+                                                          pull_slots) \
+                + 2 * prep
+            n_passes = 2
+        elif self.mode == "pull":
+            total = pass_bytes(pull_streams, pull_slots) + prep
+            n_passes = 1
         else:
-            prep = 3 * word_planes                # mask + permute gather
-        n_passes = 2 if self.mode == "pushpull" else 1
-        total = n_passes * (gossip_pass_bytes + prep)
+            total = pass_bytes(y_streams, D) + prep
+            n_passes = 1
         if self.fanout > 0:
             total += R * LANES                    # shift plane
         if self._liveness:
@@ -1027,19 +1087,24 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
         # Anti-entropy: each peer pulls one random slot's neighbor's
         # full seen-set; dead/byzantine neighbors serve nothing
         # (gossip.py pull_round's alive[nbr] & ~byzantine[nbr]).
+        # With sim.pull_window the contact is drawn from the FIRST roll
+        # group only and the pass runs a Dw-slot grid (one shared block
+        # roll -> ONE seen-plane stream); Dw == n_slots when off, which
+        # reproduces the unrestricted draw and grid exactly.
         if fused:
             ys = gather(state.seen_w)
         else:
             ys = prow(gather(
                 state.seen_w & alive_w[None] & ~state.byz_w[None]))
+        Dw = sim._pull_slots
         u = row_randint(k_pull, grows, (LANES,), 0, 1 << 30, jnp.int32)
-        deg32 = topo.deg.astype(jnp.int32)
+        deg32 = jnp.minimum(topo.deg.astype(jnp.int32), Dw)
         delta = (u % jnp.maximum(deg32, 1)).astype(jnp.int8)
         delta = jnp.where(deg32 > 0, delta,
-                          jnp.int8(topo.n_slots))      # no contact
-        pulled = gossip_pass(ys, topo.colidx, delta, rolls_off,
-                             topo.subrolls, pull=True,
-                             ytab=ytab_local if fused else None,
+                          jnp.int8(Dw))                # no contact
+        pulled = gossip_pass(ys, topo.colidx[:Dw], delta, rolls_off[:Dw],
+                             topo.subrolls[:Dw], pull=True,
+                             ytab=ytab_local[:Dw] if fused else None,
                              src_ok=src_ok if fused else None,
                              acc_init=(recv if fin and
                                        sim.mode == "pushpull" else None),

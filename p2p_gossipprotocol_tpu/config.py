@@ -112,6 +112,10 @@ _SIM_INT_KEYS = {
     # pass (the kernel emits (new, seen') from its resident accumulator
     # — aligned.AlignedSimulator.fuse_update).
     "fuse_update": "fuse_update",
+    # aligned engine: 1 = draw the pull contact from the first roll
+    # group only; the pull pass then streams ONE seen-plane copy
+    # (aligned.AlignedSimulator.pull_window; needs roll_groups).
+    "pull_window": "pull_window",
     "rounds": "rounds",
     "prng_seed": "prng_seed",
     # jax backend: rounds between successive message activations —
@@ -184,6 +188,7 @@ class NetworkConfig:
         self.roll_groups = 0           # aligned engine; 0 = per-slot rolls
         self.block_perm = 0            # aligned engine; 1 = fused overlay
         self.fuse_update = 0           # aligned engine; 1 = in-kernel seen|new
+        self.pull_window = 0           # aligned engine; 1 = windowed pull
         self.rounds = 0
         self.message_stagger = 0       # 0 = all rumors at round 0
         self.mesh_devices = 0          # 0/1 = single device
@@ -310,9 +315,9 @@ class NetworkConfig:
         if not is_valid_port(self.local_port):
             raise ConfigError(f"Invalid local_port: {self.local_port}")
         for k in ("n_peers", "n_messages", "avg_degree", "ba_m", "fanout",
-                  "roll_groups", "block_perm", "fuse_update", "rounds",
-                  "prng_seed", "anti_entropy_interval", "message_stagger",
-                  "mesh_devices", "msg_shards"):
+                  "roll_groups", "block_perm", "fuse_update", "pull_window",
+                  "rounds", "prng_seed", "anti_entropy_interval",
+                  "message_stagger", "mesh_devices", "msg_shards"):
             if getattr(self, k) < 0:
                 raise ConfigError(f"{k} must be non-negative")
         # msg_shards/mesh_devices CROSS-field rules are deliberately not

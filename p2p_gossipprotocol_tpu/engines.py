@@ -118,7 +118,8 @@ def build_simulator(cfg, *, n_peers: int | None = None,
             max_strikes=sim.max_strikes,
             liveness_every=sim.liveness_every,
             message_stagger=sim.message_stagger,
-            fuse_update=sim.fuse_update, seed=sim.seed)
+            fuse_update=sim.fuse_update, pull_window=sim.pull_window,
+            seed=sim.seed)
         if msg_shards > 1:
             # 2-D mesh: message planes x peer rows (the SP analogue,
             # parallel/aligned_2d.py)

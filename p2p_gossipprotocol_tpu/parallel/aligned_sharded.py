@@ -87,6 +87,7 @@ class AlignedShardedSimulator:
     liveness_every: int = 1
     message_stagger: int = 0
     fuse_update: bool = False
+    pull_window: bool = False
     seed: int = 0
     interpret: bool | None = None
 
@@ -110,6 +111,7 @@ class AlignedShardedSimulator:
             liveness_every=self.liveness_every,
             message_stagger=self.message_stagger,
             fuse_update=self.fuse_update,
+            pull_window=self.pull_window,
             seed=self.seed, interpret=self.interpret)
         self.churn = self._inner.churn
         self.interpret = self._inner.interpret
