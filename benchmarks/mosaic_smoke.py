@@ -174,10 +174,16 @@ def main():
             liveness_every=2, seed=1, interpret=interp)) and None))
 
     # 6e) windowed pull (round-5 pull_window): the pull pass on a
-    #     window-sized grid, composed with fuse_update
+    #     window-sized grid, composed with fuse_update.  rowblk=8 keeps
+    #     t_blocks > 1 so the 2 roll groups draw DISTINCT rolls and the
+    #     window (4 of 8 slots) is a real grid restriction — at the
+    #     default block this n has ONE row block, every roll is 0, and
+    #     the "windowed" pass would silently be the full grid.
+    topo_pw = build_aligned(seed=3, n=n, n_slots=8, roll_groups=2,
+                            rowblk=8)
     results.append(_check("pull_window", lambda: _run_pair(
         lambda interp: AlignedSimulator(
-            topo=topo_rg, n_msgs=64, mode="pushpull", pull_window=True,
+            topo=topo_pw, n_msgs=64, mode="pushpull", pull_window=True,
             fuse_update=True,
             churn=ChurnConfig(rate=0.05, kill_round=1), max_strikes=2,
             liveness_every=3, seed=1, interpret=interp)) and None))

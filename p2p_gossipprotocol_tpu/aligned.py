@@ -124,6 +124,12 @@ class AlignedTopology:
     #: mask ANDed in-kernel, so the per-pass host-side permute+mask prep
     #: (the traffic model's 3W term) does not exist at all.
     ytab: jax.Array | None = None
+    #: distinct block rolls the overlay was BUILT with (None = one per
+    #: slot).  Static record, not an inference: pull_window's validity
+    #: guard reads this — per-slot-roll overlays whose first two rolls
+    #: happen to coincide must still be rejected deterministically.
+    roll_groups: int | None = struct.field(pytree_node=False,
+                                           default=None)
 
     @property
     def rows(self) -> int:
@@ -284,6 +290,7 @@ def build_aligned(seed: int, n: int, n_slots: int = 16,
         valid_w=jnp.asarray(np.where(valid, -1, 0).astype(np.int32)),
         n_peers=n, n_slots=n_slots, rowblk=blk,
         ytab=None if ytab is None else jnp.asarray(ytab),
+        roll_groups=None if roll_groups is None else n_groups,
     )
 
 
@@ -509,6 +516,14 @@ class AlignedSimulator:
         # window is all slots — the unified pull path below then draws
         # and streams exactly what it always did.
         if self.pull_window:
+            # The guard reads the overlay's BUILT grouping, never an
+            # inference from the drawn rolls (a per-slot overlay whose
+            # first two rolls coincide by chance must still be
+            # rejected, deterministically).
+            if self.topo.roll_groups is None:
+                raise ValueError(
+                    "pull_window needs a roll-grouped overlay "
+                    "(build_aligned(roll_groups=g) with g <= n_slots/2)")
             rolls_np = np.asarray(self.topo.rolls)
             changes = np.nonzero(np.diff(rolls_np))[0]
             self._pull_slots = (int(changes[0]) + 1 if changes.size

@@ -77,10 +77,18 @@ def test_pull_window_model_bytes_drop():
 
 
 def test_pull_window_rejects_degenerate_layouts():
-    # per-slot rolls: first run is one slot -> same neighbor every round
+    # per-slot rolls: rejected from the BUILT grouping (deterministic —
+    # a seed whose first two rolls coincide must not be accepted)
     topo = build_aligned(seed=1, n=4096, n_slots=8, rowblk=8)
+    assert topo.roll_groups is None
     with pytest.raises(ValueError, match="roll-grouped"):
         AlignedSimulator(topo=topo, n_msgs=8, mode="pull",
+                         pull_window=True, seed=0)
+    # groups of ONE slot: window 1 = the same neighbor every round
+    topo1 = build_aligned(seed=1, n=4096, n_slots=8, roll_groups=8,
+                          rowblk=8)
+    with pytest.raises(ValueError, match=">= 2 slots"):
+        AlignedSimulator(topo=topo1, n_msgs=8, mode="pull",
                          pull_window=True, seed=0)
     # push mode has no pull pass to window
     topo_g = build_aligned(seed=1, n=4096, n_slots=8, roll_groups=2,
