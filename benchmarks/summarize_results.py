@@ -109,6 +109,23 @@ def main() -> int:
             cut = 1 - fused2["ms_per_round"] / legacy["ms_per_round"]
             report.append(f"- VERDICT block-perm: fused-2 vs legacy-4 "
                           f"ms/round cut = {cut:.1%} (model said 43%)")
+        byname = {r["config"]: r for r in r5}
+        for n_msgs, tag in ((16, "1m_16msg_bp0_g4"), (256, "1m_256msg_bp0_g4")):
+            off = byname.get(f"{tag}_fuse_0")
+            on = byname.get(f"{tag}_fuse_1")
+            if off and on and off.get("ms_per_round"):
+                cut = 1 - on["ms_per_round"] / off["ms_per_round"]
+                report.append(f"- VERDICT fuse_update @ {n_msgs} msgs: "
+                              f"ms/round cut = {cut:.1%}")
+        for tag in ("1m_16msg_bp0_g4", "1m_256msg_bp1_g2"):
+            off = byname.get(f"{tag}_pullwin_0")
+            on = byname.get(f"{tag}_pullwin_1")
+            if off and on and off.get("ms_per_round"):
+                cut = 1 - on["ms_per_round"] / off["ms_per_round"]
+                report.append(
+                    f"- VERDICT pull_window @ {tag}: ms/round cut = "
+                    f"{cut:.1%}, rounds {off.get('rounds')} -> "
+                    f"{on.get('rounds')} (convergence cost if > 0)")
 
     base = rows("baselines_tpu.jsonl")
     if base:
