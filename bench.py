@@ -209,15 +209,53 @@ def _metric_name(n: int, mode: str, platform: str) -> str:
     return name                 # TPU headline (VERDICT r2 weak #8)
 
 
+def _recorded_tpu() -> dict | None:
+    """The watchdog-recorded TPU headline from THIS round, if one landed
+    (benchmarks/results/bench_r5_tpu.json): a CPU-fallback or error line
+    carries it as ``tpu_result_this_round`` so a dead tunnel at
+    round-end cannot hide a real hardware number that was already
+    measured and committed earlier in the round.
+
+    The watchdog runs ``bench.py > bench_r5_tpu.json`` — the shell
+    truncates the file BEFORE this process starts — so an empty or
+    unparseable file falls back to the git-committed copy (HEAD), which
+    is exactly the record the docstring's contract is about."""
+    rel = os.path.join("benchmarks", "results", "bench_r5_tpu.json")
+    repo = os.path.dirname(os.path.abspath(__file__))
+    rec = None
+    try:
+        with open(os.path.join(repo, rel)) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        try:
+            blob = subprocess.run(
+                ["git", "-C", repo, "show", f"HEAD:{rel}"],
+                capture_output=True, timeout=10)
+            if blob.returncode == 0:
+                rec = json.loads(blob.stdout)
+        except (OSError, ValueError, subprocess.SubprocessError):
+            rec = None
+    if (not isinstance(rec, dict)
+            or rec.get("platform") not in ("tpu", "axon")
+            or not rec.get("value")):
+        return None
+    return {k: rec.get(k) for k in ("metric", "value", "unit",
+                                    "vs_baseline", "device")}
+
+
 def _emit_error(n, mode, engine, err, platform: str = "unknown") -> int:
-    print(json.dumps({
+    row = {
         "metric": _metric_name(n, mode, platform),
         "value": None, "unit": "s", "vs_baseline": None,
         "error": f"{type(err).__name__}: {err}",
         "device": None,
         "platform": platform if platform != "unknown" else None,
         "engine": engine, "n_peers": n,
-    }))
+    }
+    tpu = _recorded_tpu()
+    if tpu:
+        row["tpu_result_this_round"] = tpu
+    print(json.dumps(row))
     return 1
 
 
@@ -291,6 +329,11 @@ def main() -> int:
     device = str(devices[0]).replace(" ", "_")
     is_baseline_cfg = (n == BASELINE_PEERS and platform in TPU_PLATFORMS
                        and wall > 0)
+    fb_extras = {}
+    if os.environ.get("GOSSIP_BENCH_IS_FALLBACK"):
+        tpu = _recorded_tpu()
+        if tpu:
+            fb_extras["tpu_result_this_round"] = tpu
     print(json.dumps({
         "metric": _metric_name(n, mode, platform),
         "value": round(wall, 4),
@@ -310,6 +353,7 @@ def main() -> int:
         "platform": platform,
         "fallback": bool(os.environ.get("GOSSIP_BENCH_IS_FALLBACK")),
         **extras,
+        **fb_extras,
     }))
     return 0
 

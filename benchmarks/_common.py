@@ -1,0 +1,31 @@
+"""Shared resume helper for the measurement harnesses.
+
+Tunnel windows are short and can die mid-chain, so every harness
+(measure_round4/5, run_baselines) appends each row the moment it lands
+and skips configs already recorded — ONE definition of "recorded" so
+the three scripts can never drift on what counts as landed.
+"""
+import json
+
+
+def landed(path) -> set:
+    """Config names already recorded in ``path`` (a JSONL artifact).
+
+    A row counts when it is parseable, carries no ``error`` field, and —
+    for row shapes that report a ``value`` — the value is non-null (the
+    run_baselines error shape is ``value: None`` + ``error``; the
+    measure_round* shapes have no ``value`` key at all)."""
+    done = set()
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if "error" in row or row.get("value", True) is None:
+                    continue
+                done.add(row.get("config"))
+    except OSError:
+        pass
+    return done

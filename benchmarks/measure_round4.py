@@ -33,6 +33,14 @@ def emit(row):
         f.write(json.dumps(row) + "\n")
 
 
+def _landed() -> set:
+    """Configs already recorded in OUT — tunnel windows are short, so a
+    rerun after a mid-chain death must go straight to the missing rows
+    (the 01:11Z window died between 10m_32msg and 10m_256msg)."""
+    from benchmarks._common import landed
+    return landed(OUT)
+
+
 def main():
     from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator,
                                                 aligned_coverage,
@@ -40,11 +48,18 @@ def main():
     from p2p_gossipprotocol_tpu.aligned_sir import AlignedSIRSimulator
     from p2p_gossipprotocol_tpu.liveness import ChurnConfig
 
+    done = _landed()
+
     # --- 1) liveness stride x roll groups at 1M x 32 msgs -----------------
     for groups in (None, 4):
+        if all(f"1m_32msg_liveness_every_{e}_groups_{groups}" in done
+               for e in (1, 3)):
+            continue
         topo1m = build_aligned(seed=7, n=1 << 20, n_slots=16,
                                degree_law="powerlaw", roll_groups=groups)
         for every in (1, 3):
+            if f"1m_32msg_liveness_every_{every}_groups_{groups}" in done:
+                continue
             sim = AlignedSimulator(
                 topo=topo1m, n_msgs=32, mode="pushpull",
                 churn=ChurnConfig(rate=0.05, kill_round=1),
@@ -64,16 +79,20 @@ def main():
         del topo1m
 
     # --- 2) the 1M north-star config through bench's own path ------------
-    os.environ.setdefault("GOSSIP_BENCH_LIVENESS_EVERY", "3")
-    import bench as bench_mod
-    (rounds, wall, total_seen, n_edges, graph_s,
-     extras) = bench_mod._bench_aligned(1 << 20, 16, 16, "pushpull")
-    emit({"config": "pl1m_churn_r4", "n_peers": 1 << 20, "n_msgs": 16,
-          "rounds": rounds, "wall_s": round(wall, 4),
-          "graph_build_s": round(graph_s, 2), **extras})
+    if "pl1m_churn_r4" not in done:
+        os.environ.setdefault("GOSSIP_BENCH_LIVENESS_EVERY", "3")
+        import bench as bench_mod
+        (rounds, wall, total_seen, n_edges, graph_s,
+         extras) = bench_mod._bench_aligned(1 << 20, 16, 16, "pushpull")
+        emit({"config": "pl1m_churn_r4", "n_peers": 1 << 20, "n_msgs": 16,
+              "rounds": rounds, "wall_s": round(wall, 4),
+              "graph_build_s": round(graph_s, 2), **extras})
 
     # --- 3) 10M x 32 and the 256-message headline -------------------------
     for n_msgs in (32, 256):
+        if (f"10m_{n_msgs}msg_churn" in done
+                and (n_msgs != 32 or "10m_32msg_profile" in done)):
+            continue
         t0 = time.perf_counter()
         topo = build_aligned(seed=0, n=10_000_000, n_slots=16,
                              degree_law="powerlaw", n_msgs=n_msgs,
@@ -86,15 +105,18 @@ def main():
             target=0.99, max_rounds=128)
         cov = aligned_coverage(sim, state, topo2)
         assert cov >= 0.99, cov
-        emit({"config": f"10m_{n_msgs}msg_churn", "n_peers": 10_000_000,
-              "n_msgs": n_msgs, "rounds": rounds,
-              "wall_s": round(wall, 4),
-              "ms_per_round": round(wall / max(rounds, 1) * 1000, 2),
-              "final_coverage": round(cov, 5),
-              "graph_build_s": round(graph_s, 2),
-              "bytes_per_round": sim.hbm_bytes_per_round(),
-              "achieved_gb_s": round(
-                  sim.hbm_bytes_per_round() * rounds / wall / 1e9, 1)})
+        if f"10m_{n_msgs}msg_churn" not in done:
+            emit({"config": f"10m_{n_msgs}msg_churn",
+                  "n_peers": 10_000_000,
+                  "n_msgs": n_msgs, "rounds": rounds,
+                  "wall_s": round(wall, 4),
+                  "ms_per_round": round(wall / max(rounds, 1) * 1000, 2),
+                  "final_coverage": round(cov, 5),
+                  "graph_build_s": round(graph_s, 2),
+                  "bytes_per_round": sim.hbm_bytes_per_round(),
+                  "achieved_gb_s": round(
+                      sim.hbm_bytes_per_round() * rounds / wall / 1e9,
+                      1)})
 
         if n_msgs == 32:
             # profiler trace of a steady-state run (compiled already);
@@ -115,17 +137,18 @@ def main():
         del topo, sim, state, topo2
 
     # --- 4) SIR at 10M on the scale engine --------------------------------
-    topo = build_aligned(seed=0, n=10_000_000, n_slots=8,
-                         degree_law="powerlaw")
-    sim = AlignedSIRSimulator(topo=topo, beta=0.3, gamma=0.1, n_seeds=10,
-                              seed=0)
-    res = sim.run(128, warmup=True)
-    emit({"config": "sir10m_aligned", "n_peers": 10_000_000,
-          "rounds": 128, "wall_s": round(res.wall_s, 4),
-          "ms_per_round": round(res.wall_s / 128 * 1000, 2),
-          "peak_infected": res.peak_infected,
-          "attack_rate": round(res.attack_rate, 4),
-          "extinct_at": res.rounds_to_extinction()})
+    if "sir10m_aligned" not in done:
+        topo = build_aligned(seed=0, n=10_000_000, n_slots=8,
+                             degree_law="powerlaw")
+        sim = AlignedSIRSimulator(topo=topo, beta=0.3, gamma=0.1,
+                                  n_seeds=10, seed=0)
+        res = sim.run(128, warmup=True)
+        emit({"config": "sir10m_aligned", "n_peers": 10_000_000,
+              "rounds": 128, "wall_s": round(res.wall_s, 4),
+              "ms_per_round": round(res.wall_s / 128 * 1000, 2),
+              "peak_infected": res.peak_infected,
+              "attack_rate": round(res.attack_rate, 4),
+              "extinct_at": res.rounds_to_extinction()})
 
 
 if __name__ == "__main__":

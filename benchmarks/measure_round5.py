@@ -56,6 +56,14 @@ def emit(row):
         f.write(json.dumps(row) + "\n")
 
 
+def _landed() -> set:
+    """Configs already recorded in OUT, so a window that dies mid-chain
+    resumes at the first missing row instead of recompiling everything
+    (same discipline as measure_round4)."""
+    from benchmarks._common import landed
+    return landed(OUT)
+
+
 def _time(fn, *args, iters=20):
     out = fn(*args)
     jax.block_until_ready(out)        # compile + upload excluded
@@ -66,10 +74,12 @@ def _time(fn, *args, iters=20):
     return (time.perf_counter() - t0) / iters
 
 
-def bench_prep_term(n=1 << 20):
+def bench_prep_term(n=1 << 20, done=frozenset()):
     """The per-pass XLA prep in isolation, W = 1/4/8 planes."""
     from p2p_gossipprotocol_tpu.aligned import build_aligned
 
+    if all(f"prep_term_w{W}" in done for W in (1, 4, 8)):
+        return
     topo = build_aligned(seed=0, n=n, n_slots=16, degree_law="powerlaw",
                          roll_groups=4)
     R = topo.rows
@@ -77,6 +87,8 @@ def bench_prep_term(n=1 << 20):
     alive_w = jnp.full((R, LANES), -1, jnp.int32)
 
     for W in (1, 4, 8):
+        if f"prep_term_w{W}" in done:
+            continue
         frontier = jax.random.randint(key, (W, R, LANES),
                                       jnp.iinfo(jnp.int32).min,
                                       jnp.iinfo(jnp.int32).max, jnp.int32)
@@ -96,7 +108,7 @@ def bench_prep_term(n=1 << 20):
               "achieved_gb_s_vs_model": round(charged / dt / 1e9, 1)})
 
 
-def bench_roll_group_reuse(n=1 << 20):
+def bench_roll_group_reuse(n=1 << 20, done=frozenset()):
     """gossip_pass alone at EXACT distinct-roll counts — if the pallas
     pipeline really serves same-roll slots from the resident buffer,
     time tracks the distinct-roll count, not the slot count.
@@ -117,6 +129,24 @@ def bench_roll_group_reuse(n=1 << 20):
     from p2p_gossipprotocol_tpu.aligned import build_aligned
     from p2p_gossipprotocol_tpu.ops.aligned_kernel import gossip_pass
 
+    if ("roll_reuse_speedup_16_over_4" in done
+            and all(f"kernel_only_rolls_{g}" in done for g in (16, 4, 2, 1))):
+        return
+    # Backfill timings for rows that already landed so a partial resume
+    # neither re-emits them nor loses the speedup summary.
+    times = {}
+    try:
+        with open(OUT) as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                cfg = str(row.get("config", ""))
+                if cfg.startswith("kernel_only_rolls_") and "ms" in row:
+                    times[int(cfg.rsplit("_", 1)[1])] = row["ms"] / 1e3
+    except OSError:
+        pass
     key = jax.random.PRNGKey(1)
     D = 16
     base = build_aligned(seed=0, n=n, n_slots=D, degree_law="powerlaw")
@@ -125,8 +155,9 @@ def bench_roll_group_reuse(n=1 << 20):
     y = jax.random.randint(key, (1, R, LANES),
                            jnp.iinfo(jnp.int32).min,
                            jnp.iinfo(jnp.int32).max, jnp.int32)
-    times = {}
     for g in (16, 4, 2, 1):
+        if f"kernel_only_rolls_{g}" in done:
+            continue
         # g DISTINCT block offsets, one per contiguous slot group
         vals = (np.arange(g, dtype=np.int64)
                 * max(t_blocks // max(g, 1), 1)) % max(t_blocks, 1)
@@ -145,14 +176,15 @@ def bench_roll_group_reuse(n=1 << 20):
         emit({"config": f"kernel_only_rolls_{g}", "n_peers": n,
               "unique_rolls": int(len(np.unique(rolls))),
               "model_y_streams": streams, "ms": round(dt * 1e3, 3)})
-    if times.get(4):
+    if (times.get(16) and times.get(4)
+            and "roll_reuse_speedup_16_over_4" not in done):
         emit({"config": "roll_reuse_speedup_16_over_4",
               "value": round(times[16] / times[4], 2),
               "expect_if_reuse_real": "~2-4x",
               "expect_if_no_reuse": "~1x"})
 
 
-def bench_block_perm_ab(n=1 << 20):
+def bench_block_perm_ab(n=1 << 20, done=frozenset()):
     """Fused (block-perm) vs legacy overlay, full rounds at 1M x 256
     messages (W=8, where the removed 3W prep term is largest): the
     direct end-to-end measurement of round-4 verdict item 3.  Target:
@@ -166,6 +198,8 @@ def bench_block_perm_ab(n=1 << 20):
     # one roll is rejected by build_aligned: the block-level overlay
     # would be a single permutation cycle and dissemination stalls)
     for bp, groups in ((False, 4), (True, 4), (True, 2)):
+        if f"1m_256msg_block_perm_{int(bp)}_groups_{groups}" in done:
+            continue
         topo = build_aligned(seed=7, n=n, n_slots=16,
                              degree_law="powerlaw", roll_groups=groups,
                              n_msgs=256, block_perm=bp)
@@ -186,7 +220,7 @@ def bench_block_perm_ab(n=1 << 20):
                   sim.hbm_bytes_per_round() * 12 / res.wall_s / 1e9, 1)})
 
 
-def bench_fuse_update_ab(n=1 << 20):
+def bench_fuse_update_ab(n=1 << 20, done=frozenset()):
     """In-kernel seen-update (fuse_update) vs the XLA elementwise update,
     at the headline 1M x 16 config and at 1M x 256 (W=8, where the
     update planes are widest), on both overlay families.  Model: -2W
@@ -201,6 +235,9 @@ def bench_fuse_update_ab(n=1 << 20):
 
     for n_msgs, bp, groups in ((16, False, 4), (16, True, 2),
                                (256, False, 4), (256, True, 2)):
+        if all(f"1m_{n_msgs}msg_bp{int(bp)}_g{groups}_fuse_{int(f)}"
+               in done for f in (0, 1)):
+            continue
         # fused update halves the kernel VMEM budget: bound the row
         # block by the halved budget directly (same rule as from_config)
         blk = min(512, max(8, (MAX_WORDS_X_ROWBLK // 2)
@@ -209,6 +246,9 @@ def bench_fuse_update_ab(n=1 << 20):
                              degree_law="powerlaw", roll_groups=groups,
                              n_msgs=n_msgs, rowblk=blk, block_perm=bp)
         for fuse in (False, True):
+            if (f"1m_{n_msgs}msg_bp{int(bp)}_g{groups}"
+                    f"_fuse_{int(fuse)}") in done:
+                continue
             sim = AlignedSimulator(
                 topo=topo, n_msgs=n_msgs, mode="pushpull",
                 churn=ChurnConfig(rate=0.05, kill_round=1),
@@ -227,7 +267,7 @@ def bench_fuse_update_ab(n=1 << 20):
                       1)})
 
 
-def bench_pull_window_ab(n=1 << 20):
+def bench_pull_window_ab(n=1 << 20, done=frozenset()):
     """Windowed pull vs full-width pull at 1M x 16 and 1M x 256
     (pushpull, churned): model says the pull pass's seen-plane stream
     drops from `streams` to 1 and its lane table by D/window — -8% at
@@ -240,10 +280,16 @@ def bench_pull_window_ab(n=1 << 20):
     from p2p_gossipprotocol_tpu.liveness import ChurnConfig
 
     for n_msgs, bp, groups in ((16, False, 4), (256, True, 2)):
+        if all(f"1m_{n_msgs}msg_bp{int(bp)}_g{groups}_pullwin_{int(p)}"
+               in done for p in (0, 1)):
+            continue
         topo = build_aligned(seed=7, n=n, n_slots=16,
                              degree_law="powerlaw", roll_groups=groups,
                              n_msgs=n_msgs, block_perm=bp)
         for pw in (False, True):
+            if (f"1m_{n_msgs}msg_bp{int(bp)}_g{groups}"
+                    f"_pullwin_{int(pw)}") in done:
+                continue
             sim = AlignedSimulator(
                 topo=topo, n_msgs=n_msgs, mode="pushpull",
                 churn=ChurnConfig(rate=0.05, kill_round=1),
@@ -263,15 +309,19 @@ def bench_pull_window_ab(n=1 << 20):
                       sim.hbm_bytes_per_round() * rounds / wall / 1e9, 1)})
 
 
-def bench_stagger_ab(n=1 << 20):
+def bench_stagger_ab(n=1 << 20, done=frozenset()):
     from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator,
                                                 aligned_coverage,
                                                 build_aligned)
     from p2p_gossipprotocol_tpu.liveness import ChurnConfig
 
+    if all(f"1m_32msg_stagger_{s}" in done for s in (0, 1)):
+        return
     topo = build_aligned(seed=7, n=n, n_slots=16, degree_law="powerlaw",
                          roll_groups=4)
     for stagger in (0, 1):
+        if f"1m_32msg_stagger_{stagger}" in done:
+            continue
         sim = AlignedSimulator(
             topo=topo, n_msgs=32, mode="pushpull",
             churn=ChurnConfig(rate=0.05, kill_round=1), max_strikes=3,
@@ -294,13 +344,15 @@ def main():
         print(f"not on TPU (backend={backend}) — round-5 microbenches "
               "need the chip", file=sys.stderr)
         return 2
-    emit({"config": "_backend", "backend": backend})
-    bench_prep_term()
-    bench_roll_group_reuse()
-    bench_block_perm_ab()
-    bench_fuse_update_ab()
-    bench_pull_window_ab()
-    bench_stagger_ab()
+    done = _landed()
+    if "_backend" not in done:
+        emit({"config": "_backend", "backend": backend})
+    bench_prep_term(done=done)
+    bench_roll_group_reuse(done=done)
+    bench_block_perm_ab(done=done)
+    bench_fuse_update_ab(done=done)
+    bench_pull_window_ab(done=done)
+    bench_stagger_ab(done=done)
     return 0
 
 

@@ -228,11 +228,36 @@ BENCHES = [bench_socket8, bench_er10k, bench_ba100k_sir,
 def main() -> int:
     only = os.environ.get("GOSSIP_BASELINE_ONLY")
     os.makedirs(RESULTS_DIR, exist_ok=True)
+
+    # Resume discipline (same as measure_round4/5): the output file is
+    # keyed by platform, known up front; configs already recorded there
+    # are skipped, and each new row is appended the moment it lands so a
+    # tunnel death mid-sweep loses nothing.  The platform probe MUST be
+    # hang-proof — jax.devices() hangs in C when the tunnel is down —
+    # so it goes through bench._init_backend (thread + timeout); a dead
+    # backend degrades to platform "unknown" with no resume skipping,
+    # and bench_socket8 (which needs no JAX at all) still runs.
+    import bench as bench_mod
+    from benchmarks._common import landed
+    try:
+        platform = bench_mod._init_backend()[0].platform.lower()
+    except RuntimeError as e:
+        print(f"# backend probe failed ({e}); socket benches only will "
+              "succeed", file=sys.stderr)
+        platform = "unknown"
+    out = os.path.join(RESULTS_DIR,
+                       f"baselines_{platform.replace('-', '_')}.jsonl")
+    done = landed(out) if platform != "unknown" else set()
+
     rows = []
     rc = 0
     for fn in BENCHES:
         name = fn.__name__.replace("bench_", "")
         if only and name != only:
+            continue
+        if not only and name in done:
+            print(f"# {name}: already recorded in {out}, skipping",
+                  file=sys.stderr)
             continue
         try:
             row = fn()
@@ -242,14 +267,9 @@ def main() -> int:
             rc = 1
         row["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
         print(json.dumps(row), flush=True)
-        rows.append(row)
-
-    platform = rows[-1].get("platform", "unknown") if rows else "unknown"
-    out = os.path.join(RESULTS_DIR,
-                       f"baselines_{platform.replace('-', '_')}.jsonl")
-    with open(out, "a") as f:
-        for row in rows:
+        with open(out, "a") as f:
             f.write(json.dumps(row) + "\n")
+        rows.append(row)
     print(f"\n# appended {len(rows)} rows to {out}", file=sys.stderr)
 
     print("\n# BASELINE.md rows:", file=sys.stderr)
