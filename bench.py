@@ -143,6 +143,14 @@ def _bench_aligned(n, n_msgs, degree, mode):
     # In-kernel seen-update / windowed pull — same opt-in discipline.
     fuse_update = bool(int(os.environ.get("GOSSIP_BENCH_FUSE_UPDATE", "0")))
     pull_window = bool(int(os.environ.get("GOSSIP_BENCH_PULL_WINDOW", "0")))
+    # Coverage-census cadence inside the while loop (run_to_coverage
+    # check_every): the census is a per-round sync barrier; K>1 checks
+    # after each K-round chunk, may overshoot by <K rounds (counted in
+    # the reported wall/rounds — conservative, never flattering).
+    # clamped to the round budget: a K that never fits under MAX_ROUNDS
+    # would silently run the per-round tail while the row claims K
+    check_every = min(int(os.environ.get("GOSSIP_BENCH_CHECK_EVERY", "1")),
+                      MAX_ROUNDS)
     t0 = time.perf_counter()
     topo = build_aligned(seed=0, n=n, n_slots=degree,
                          degree_law="powerlaw", roll_groups=roll_groups,
@@ -154,8 +162,8 @@ def _bench_aligned(n, n_msgs, degree, mode):
                            message_stagger=stagger,
                            fuse_update=fuse_update, pull_window=pull_window,
                            seed=0)
-    state, topo2, rounds, wall = sim.run_to_coverage(target=TARGET_COV,
-                                                     max_rounds=MAX_ROUNDS)
+    state, topo2, rounds, wall = sim.run_to_coverage(
+        target=TARGET_COV, max_rounds=MAX_ROUNDS, check_every=check_every)
     _check_converged(aligned_coverage(sim, state, topo2), rounds)
     # exact [hi, lo] pair: a flat int32 popcount wraps above 2^31 set
     # bits (10M peers x 256 messages)
@@ -169,6 +177,7 @@ def _bench_aligned(n, n_msgs, degree, mode):
         **({"block_perm": True} if block_perm else {}),
         **({"fuse_update": True} if fuse_update else {}),
         **({"pull_window": True} if pull_window else {}),
+        **({"check_every": check_every} if check_every > 1 else {}),
         # analytic traffic model (aligned.hbm_bytes_per_round) vs the
         # measured wall: how close the engine runs to the ~800 GB/s
         # v5e HBM roof — the round-3 judge's "quantify the gap" ask

@@ -838,18 +838,35 @@ class AlignedSimulator:
     def run_to_coverage(self, target: float = 0.99, max_rounds: int = 256,
                         state: AlignedState | None = None,
                         topo: AlignedTopology | None = None,
-                        warmup: bool = True):
+                        warmup: bool = True, check_every: int = 1):
         """(state, topo, rounds_run, wall_s) — same 4-tuple shape as
         sim.Simulator.run_to_coverage.  ``topo`` must be passed when
         resuming a churned run (rewire mutates the lane table).  Compile
         and (with ``warmup``) first-execution program-upload excluded;
         completion forced via a scalar device_get, so the wall-clock is
-        honest."""
+        honest.
+
+        ``check_every=K`` evaluates the coverage condition only after
+        each chunk of K rounds (a ``lax.scan`` inside the while body).
+        K=1 reproduces the classic loop exactly.  K>1 exists because the
+        while cond depends on the round's census reduction — a full
+        synchronization barrier per round that serializes the pipeline
+        (measured 13.6 ms/round in-loop vs 3.1 ms/round in the free-
+        running scan at 1M x 16) — and checking every K rounds amortizes
+        that barrier.  The run may overshoot convergence by up to K-1
+        rounds; those extra rounds are INCLUDED in rounds_run and the
+        wall-clock, so the reported time-to-target is conservative,
+        never flattering.  ``max_rounds`` stays a HARD cap (same
+        contract as sim.Simulator.run_to_coverage): the chunked loop
+        only takes chunks that fit under the cap, and a per-round tail
+        loop inside the same program finishes the remainder."""
         import time as _time
 
+        if check_every < 1:
+            raise ValueError("check_every must be >= 1")
         state = self.init_state() if state is None else state
         topo = self.topo if topo is None else topo
-        cache_key = (target, max_rounds)
+        cache_key = (target, max_rounds, check_every)
         if cache_key not in self._loop_cache:
             from p2p_gossipprotocol_tpu.state import stagger_sched_end
 
@@ -857,18 +874,41 @@ class AlignedSimulator:
                                           self.message_stagger)
 
             def looped(st, tp):
-                def cond(carry):
+                def want_more(carry):
                     st, tp, cov = carry
-                    return (((cov < target) | (st.round < sched_end))
-                            & (st.round < max_rounds))
+                    return (cov < target) | (st.round < sched_end)
 
-                def body(carry):
+                def round_body(carry):
                     st, tp, _ = carry
                     st, tp, metrics = self.step(st, tp)
                     return st, tp, metrics["coverage"]
 
-                return jax.lax.while_loop(cond, body,
-                                          (st, tp, jnp.float32(0)))
+                if check_every == 1:
+                    return jax.lax.while_loop(
+                        lambda c: want_more(c) & (c[0].round < max_rounds),
+                        round_body, (st, tp, jnp.float32(0)))
+
+                def chunk_body(carry):
+                    st, tp, _ = carry
+
+                    def chunk(c, _):
+                        s, t = c
+                        s, t, metrics = self.step(s, t)
+                        return (s, t), metrics["coverage"]
+
+                    (st, tp), covs = jax.lax.scan(
+                        chunk, (st, tp), None, length=check_every)
+                    return st, tp, covs[-1]
+
+                # chunked fast path: only chunks that fit under the cap
+                carry = jax.lax.while_loop(
+                    lambda c: (want_more(c)
+                               & (c[0].round + check_every <= max_rounds)),
+                    chunk_body, (st, tp, jnp.float32(0)))
+                # per-round tail (< K rounds) keeps max_rounds exact
+                return jax.lax.while_loop(
+                    lambda c: want_more(c) & (c[0].round < max_rounds),
+                    round_body, carry)
             fn = jax.jit(looped)
             self._loop_cache[cache_key] = fn.lower(state, topo).compile()
         fn_c = self._loop_cache[cache_key]

@@ -153,6 +153,31 @@ def test_run_to_coverage_honest_rounds():
     assert res.coverage[rounds - 2] < 0.99 if rounds > 1 else True
 
 
+def test_run_to_coverage_check_every_parity():
+    """check_every=K runs the SAME rounds in K-chunks: the final state is
+    bitwise-identical to the classic per-round loop when convergence
+    lands on a chunk boundary, and otherwise overshoots by < K rounds —
+    never stops early, never diverges from the deterministic stream."""
+    topo = build_aligned(seed=4, n=1024, n_slots=6)
+    sim = AlignedSimulator(topo=topo, n_msgs=4, mode="push", seed=0)
+    st1, _t1, r1, _w1 = sim.run_to_coverage(0.99, max_rounds=64)
+    for k in (2, 3):
+        stk, _tk, rk, _wk = sim.run_to_coverage(0.99, max_rounds=64,
+                                                check_every=k)
+        assert r1 <= rk < r1 + k
+        # round rk state must equal the free-running engine at rk
+        ref = sim.run(rk)
+        assert int(jax.device_get(stk.round)) == rk
+        np.testing.assert_array_equal(np.asarray(stk.seen_w),
+                                      np.asarray(ref.state.seen_w))
+    # max_rounds stays a HARD cap even when it is not a chunk multiple
+    st5, _t5, r5, _w5 = sim.run_to_coverage(0.99, max_rounds=r1 - 1,
+                                            check_every=3)
+    assert r5 == r1 - 1
+    with pytest.raises(ValueError):
+        sim.run_to_coverage(0.99, check_every=0)
+
+
 def test_dissemination_matches_exact_engine_statistically():
     """Aligned overlay (regular, avg degree 8) vs exact ER engine with the
     same average degree: rounds-to-99% must agree within a small margin —
