@@ -76,6 +76,38 @@ def test_bench_reports_traffic_model():
     assert rec["roll_groups"] == 4
 
 
+def test_bench_steady_state_and_loop_knobs():
+    """The dispatch-floor countermeasures: steady-state fields appear
+    when GOSSIP_BENCH_STEADY_ROUNDS > 0, pull_window defaults ON for a
+    roll-grouped pushpull config, and check_every=0 clamps to per-round
+    instead of crashing."""
+    proc, rec = _run({"GOSSIP_BENCH_PLATFORM": "cpu",
+                      "JAX_PLATFORMS": "cpu",
+                      "GOSSIP_BENCH_STEADY_ROUNDS": "8",
+                      "GOSSIP_BENCH_CHECK_EVERY": "0"})
+    assert proc.returncode == 0, proc.stderr
+    assert rec["pull_window"] is True          # defaulted on
+    assert rec["steady_rounds"] == 8
+    assert rec["steady_ms_per_round"] > 0
+    assert abs(rec["device_est_s"]          # both fields emit rounded
+               - rec["steady_ms_per_round"] * rec["rounds"] / 1e3) < 1e-3
+    assert "check_every" not in rec            # clamped to 1 -> omitted
+
+
+def test_bench_fallback_omits_steady_and_carries_tpu_pointer():
+    """The CPU-fallback line must not pay the steady scan (no tunnel to
+    amortize) and must carry the committed TPU headline pointer."""
+    proc, rec = _run({"JAX_PLATFORMS": "cpu",
+                      "GOSSIP_BENCH_PLATFORM": "cpu",
+                      "GOSSIP_BENCH_IS_FALLBACK": "1"})
+    assert proc.returncode == 0, proc.stderr
+    assert rec["fallback"] is True
+    assert "steady_ms_per_round" not in rec
+    tpu = rec.get("tpu_result_this_round")
+    assert tpu is not None and tpu["value"] > 0
+    assert tpu["device"].startswith("TPU")
+
+
 def test_bench_stagger_and_block_perm_knobs():
     """The round-5 env knobs reach the bench scenario and stamp the
     line: staggered generation stretches rounds (the last rumor enters
