@@ -142,9 +142,10 @@ def _bench_aligned(n, n_msgs, degree, mode):
     block_perm = bool(int(os.environ.get("GOSSIP_BENCH_BLOCK_PERM", "0")))
     # In-kernel seen-update — opt-in (measured negative on chip).
     fuse_update = bool(int(os.environ.get("GOSSIP_BENCH_FUSE_UPDATE", "0")))
-    # Windowed pull — DEFAULT ON since the on-chip A/Bs: -61% ms/round on
-    # this exact config's loop path, -58% steady-state, identical rounds
-    # and final coverage at 1M x 16 and 1M x 256 (round5_tpu.jsonl).
+    # Windowed pull — DEFAULT ON since the on-chip A/Bs: -29.5% steady-
+    # state ms/round on this exact config (256-round scans, the only
+    # timing mode the tunnel can't distort), identical rounds and final
+    # coverage at 1M x 16 and 1M x 256 (round5_tpu.jsonl).
     # The engine guards the invalid combinations (first roll group too
     # narrow, push-only mode, pull on block_perm); a DEFAULTED on falls
     # back to off when a guard rejects it (below), while an explicit

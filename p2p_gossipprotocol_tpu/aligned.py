@@ -932,7 +932,9 @@ def aligned_coverage(sim: AlignedSimulator, state: AlignedState,
     topo = sim.topo if topo is None else topo
     alive_w = jnp.where(state.alive_b, jnp.int32(-1), jnp.int32(0))
     ok_w = alive_w & ~state.byz_w & topo.valid_w
-    n_ok = max(int(jax.device_get(_popcount_sum(ok_w))) >> 5, 1)
+    # pair, not a flat sum: popcount(ok_w) = 32 x n_ok hits 2^31 at
+    # exactly 64M peers (the 64M ceiling probe came back coverage=8.0)
+    n_ok = max(_pair_int(jax.device_get(_popcount_pair(ok_w))) >> 5, 1)
     hits = _pair_int(jax.device_get(_popcount_pair(   # exact >2^31 bits
         state.seen_w & ok_w[None] & sim._honest_mask[:, None, None])))
     n_cols = sim._n_honest
@@ -1187,7 +1189,13 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
     # engine's coverage_of (sim.py:33-43).  Each ok peer contributes 32
     # bits to popcount(ok_w), hence the >> 5 peer count.
     ok_w = alive_w & ~state.byz_w & topo.valid_w
-    n_ok = jnp.maximum(reduce(_popcount_sum(ok_w)) >> 5, 1)
+    # 32 bits per ok peer, so a flat int32 popcount wraps at exactly
+    # 2^26 peers (the 64M probe: n_ok collapsed to 1, coverage 8.0).
+    # The [hi, lo] pair rides the cross-shard reduce exactly; the final
+    # float32 /32 is within +/-4 peers at 67M — invisible to any
+    # coverage threshold.
+    n_ok = jnp.maximum(
+        _pair_total(reduce(_popcount_pair(ok_w))) / 32.0, 1.0)
     if sim.message_stagger > 0:
         # mean over the columns GENERATED so far (sim.py:coverage_of has
         # the rationale: a rumor that doesn't exist — not yet scheduled,
@@ -1209,8 +1217,9 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
         n_cols = jnp.float32(sim._n_honest)
     coverage = (_pair_total(msg_reduce(_popcount_pair(
         seen & ok_w[None] & hmask[:, None, None])))
-                / (n_ok.astype(jnp.float32) * n_cols))
-    live = reduce(_popcount_sum(alive_w & topo.valid_w)) >> 5
+                / (n_ok * n_cols))
+    live = _pair_total(reduce(_popcount_pair(
+        alive_w & topo.valid_w))) / 32.0
     state = AlignedState(seen_w=seen, frontier_w=new, alive_b=alive_b,
                          byz_w=state.byz_w, strikes=strikes, key=key,
                          round=state.round + 1)

@@ -153,6 +153,20 @@ def test_run_to_coverage_honest_rounds():
     assert res.coverage[rounds - 2] < 0.99 if rounds > 1 else True
 
 
+def test_popcount_pair_exact_at_the_64m_boundary():
+    """popcount(alive plane) = 32 bits x peers hits EXACTLY 2^31 at 64M
+    peers (R = 524288 rows) — the flat int32 sum wraps to -2^31 there,
+    which collapsed n_ok to 1 and reported coverage 8.0 on the 64M
+    hardware probe.  The [hi, lo] pair must stay exact."""
+    from p2p_gossipprotocol_tpu.aligned import (_pair_int, _popcount_pair,
+                                                _popcount_sum)
+    R = 524288                       # 64M peers / 128 lanes
+    plane = jnp.full((R, 128), -1, jnp.int32)
+    assert _pair_int(jax.device_get(_popcount_pair(plane))) == 1 << 31
+    # and the flat sum really does wrap (the failure mode being pinned)
+    assert int(jax.device_get(_popcount_sum(plane))) == -(1 << 31)
+
+
 def test_run_to_coverage_check_every_parity():
     """check_every=K runs the SAME rounds in K-chunks: the final state is
     bitwise-identical to the classic per-round loop when convergence
