@@ -126,6 +126,25 @@ def main() -> int:
                     f"- VERDICT pull_window @ {tag}: ms/round cut = "
                     f"{cut:.1%}, rounds {off.get('rounds')} -> "
                     f"{on.get('rounds')} (convergence cost if > 0)")
+        s_off = byname.get("1m_16msg_steady256_pullwin_0")
+        s_on = byname.get("1m_16msg_steady256_pullwin_1")
+        if s_off and s_on and s_off.get("steady_ms_per_round"):
+            cut = 1 - (s_on["steady_ms_per_round"]
+                       / s_off["steady_ms_per_round"])
+            report.append(
+                f"- VERDICT pull_window steady-state (256-round scans, "
+                f"the tunnel-proof mode): "
+                f"{s_off['steady_ms_per_round']} -> "
+                f"{s_on['steady_ms_per_round']} ms/round ({cut:.1%})")
+        for tag in ("32m_16msg_pullwin_ceiling", "64m_16msg_pullwin_ceiling",
+                    "10m_32msg_pullwin_loop_steady"):
+            r = byname.get(tag)
+            if r:
+                core = {k: r[k] for k in ("n_peers", "rounds", "wall_s",
+                                          "final_coverage",
+                                          "steady_ms_per_round",
+                                          "device_est_s") if k in r}
+                report.append(f"- CEILING `{tag}`: {json.dumps(core)}")
 
     base = rows("baselines_tpu.jsonl")
     if base:
