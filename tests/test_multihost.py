@@ -22,7 +22,7 @@ def test_two_process_distributed_rehearsal():
     proc = subprocess.run(
         [sys.executable,
          os.path.join(REPO_ROOT, "benchmarks", "multihost_rehearsal.py"),
-         "--rounds", "12"],
+         "--rounds", "16"],     # windowed pull needs ~2 extra rounds
         capture_output=True, text=True, timeout=570, env=env,
         cwd=REPO_ROOT)
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
