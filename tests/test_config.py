@@ -249,7 +249,7 @@ def test_config_parser_never_crashes_on_junk(tmp_path):
             pass
 
 
-def test_examples_scale_config_selects_the_fused_engine():
+def test_examples_scale_config_selects_the_measured_best_layout():
     """examples/scale.txt (the scale-engine showcase) parses and routes
     onto the aligned engine with the round-5 features on — the example
     must never rot."""
@@ -258,10 +258,13 @@ def test_examples_scale_config_selects_the_fused_engine():
 
     cfg = NetworkConfig("/root/repo/examples/scale.txt")
     assert (cfg.engine, cfg.mode) == ("aligned", "pushpull")
-    assert cfg.block_perm == 1 and cfg.message_stagger == 1
+    # measured-best layout (docs/PERFORMANCE.md): windowed pull on a
+    # roll-grouped overlay; fuse_update/block_perm off at this width
+    assert cfg.pull_window == 1 and cfg.message_stagger == 1
+    assert cfg.roll_groups == 4 and not cfg.fuse_update
     # cheap instantiation: shrink the peer count, keep every knob
     sim, engine = build_simulator(cfg, n_peers=4096)
     assert engine == "aligned"
-    assert sim.topo.ytab is not None
+    assert sim.pull_window and sim.topo.roll_groups == 4
     assert sim.message_stagger == 1
     assert sim.liveness_every == 3          # 13 s / 5 s
