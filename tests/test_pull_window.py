@@ -126,6 +126,25 @@ def test_pull_window_sharded_parity(devices8):
                                   np.asarray(sh.coverage))
 
 
+def test_pull_window_2d_mesh_parity(devices8):
+    """The 2-D (msgs x peers) mesh inherits the windowed pull through
+    the shared aligned_round — bitwise vs the unsharded windowed run."""
+    from p2p_gossipprotocol_tpu.parallel import (Aligned2DShardedSimulator,
+                                                 make_mesh_2d)
+
+    topo = build_aligned(seed=3, n=8192, n_slots=8, roll_groups=2,
+                         n_shards=8)
+    kw = dict(topo=topo, n_msgs=64, mode="pushpull",
+              churn=ChurnConfig(rate=0.05, kill_round=1), max_strikes=3,
+              liveness_every=2, pull_window=True, seed=5)
+    base = AlignedSimulator(**kw).run(4)
+    sh2 = Aligned2DShardedSimulator(mesh=make_mesh_2d(2, 4), **kw).run(4)
+    np.testing.assert_array_equal(np.asarray(base.state.seen_w),
+                                  np.asarray(sh2.state.seen_w))
+    np.testing.assert_array_equal(np.asarray(base.coverage),
+                                  np.asarray(sh2.coverage))
+
+
 def test_pull_window_config_key(tmp_path):
     p = tmp_path / "net.txt"
     p.write_text("10.0.0.1:9000\nbackend=jax\nengine=aligned\n"
