@@ -140,10 +140,12 @@ class AlignedSIRSimulator:
         )
 
     # ------------------------------------------------------------------
-    def step(self, state: AlignedSIRState
+    def step(self, state: AlignedSIRState,
+             topo: AlignedTopology | None = None
              ) -> tuple[AlignedSIRState, dict]:
-        grows = jnp.arange(self.topo.rows, dtype=jnp.int32)
-        return aligned_sir_round(self, state, self.topo, grows=grows,
+        topo = self.topo if topo is None else topo
+        grows = jnp.arange(topo.rows, dtype=jnp.int32)
+        return aligned_sir_round(self, state, topo, grows=grows,
                                  t_off=jnp.int32(0),
                                  gather=lambda x: x, reduce=lambda x: x)
 
@@ -162,17 +164,22 @@ class AlignedSIRSimulator:
 
         state = self.init_state() if state is None else state
         if rounds not in self._scan_cache:
-            def scanned(st):
+            # topo is a traced ARGUMENT, never a closure capture: a
+            # captured topology is baked into the HLO as a constant,
+            # and at 32M+ peers the serialized lane table alone blew
+            # the remote-compile transport's body limit (HTTP 413) —
+            # the gossip engine's run() passes it for the same reason
+            def scanned(st, tp):
                 def body(carry, _):
-                    s, metrics = self.step(carry)
+                    s, metrics = self.step(carry, tp)
                     return s, metrics
                 return jax.lax.scan(body, st, None, length=rounds)
             self._scan_cache[rounds] = jax.jit(scanned)
         if warmup:
-            w_state, _ = self._scan_cache[rounds](state)
+            w_state, _ = self._scan_cache[rounds](state, self.topo)
             int(jax.device_get(w_state.round))
         t0 = _time.perf_counter()
-        state, ys = self._scan_cache[rounds](state)
+        state, ys = self._scan_cache[rounds](state, self.topo)
         int(jax.device_get(state.round))   # forces completion
         wall = _time.perf_counter() - t0
         return SIRResult.from_metrics(state, self.topo, ys, wall)

@@ -362,13 +362,17 @@ class SIRSimulator:
         if not 0.0 <= self.gamma <= 1.0:
             raise ValueError("sir_gamma must be in [0, 1]")
 
-        def _scan(st, rounds):
+        # topo rides as a traced argument, not a closure capture — a
+        # captured topology is baked into the HLO as a constant, which
+        # blows the remote-compile transport's body limit at scale
+        # (HTTP 413; first hit by the aligned SIR engine at 32M)
+        def _scan(st, tp, rounds):
             def body(carry, _):
-                s, metrics = self.step(carry)
+                s, metrics = self.step(carry, tp)
                 return s, metrics
             return jax.lax.scan(body, st, None, length=rounds)
 
-        self._scan_jit = jax.jit(_scan, static_argnums=1)
+        self._scan_jit = jax.jit(_scan, static_argnums=2)
 
     # ------------------------------------------------------------------
     def init_state(self) -> SIRState:
@@ -376,12 +380,14 @@ class SIRSimulator:
                               n_seeds=self.n_seeds)
 
     # ------------------------------------------------------------------
-    def step(self, state: SIRState) -> tuple[SIRState, dict]:
+    def step(self, state: SIRState, topo: Topology | None = None
+             ) -> tuple[SIRState, dict]:
         """One round: churn → masked SIR contact/recovery → census."""
+        topo = self.topo if topo is None else topo
         key, k_churn = jax.random.split(state.key)
         alive = churn_step(k_churn, state.alive, state.round, self.churn)
         state = state.replace(alive=alive, key=key)
-        state, n_new = sir_round(state, self.topo, beta=self.beta,
+        state, n_new = sir_round(state, topo, beta=self.beta,
                                  gamma=self.gamma)
         metrics = {
             "susceptible": jnp.sum(state.susceptible, dtype=jnp.int32),
@@ -398,7 +404,7 @@ class SIRSimulator:
 
         state = self.init_state() if state is None else state
         t0 = _time.perf_counter()
-        state, ys = self._scan_jit(state, rounds)
+        state, ys = self._scan_jit(state, self.topo, rounds)
         jax.block_until_ready(state.compartment)
         wall = _time.perf_counter() - t0
         return SIRResult.from_metrics(state, self.topo, ys, wall)
