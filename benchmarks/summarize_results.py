@@ -137,11 +137,13 @@ def main() -> int:
                 f"{s_off['steady_ms_per_round']} -> "
                 f"{s_on['steady_ms_per_round']} ms/round ({cut:.1%})")
         for tag in ("32m_16msg_pullwin_ceiling", "64m_16msg_pullwin_ceiling",
-                    "10m_32msg_pullwin_loop_steady"):
+                    "10m_32msg_pullwin_loop_steady", "sir64m_aligned",
+                    "byz64m_sharded_1dev"):
             r = byname.get(tag)
             if r:
                 core = {k: r[k] for k in ("n_peers", "rounds", "wall_s",
-                                          "final_coverage",
+                                          "final_coverage", "evictions",
+                                          "peak_infected", "attack_rate",
                                           "steady_ms_per_round",
                                           "device_est_s") if k in r}
                 report.append(f"- CEILING `{tag}`: {json.dumps(core)}")
