@@ -50,6 +50,27 @@ MAX_ROUNDS = 128
 TPU_PLATFORMS = ("tpu", "axon")
 
 
+def _call_with_timeout(fn, timeout_s: float | None):
+    """Run ``fn`` on a daemon thread; returns ('ok', value), ('error',
+    exc), or ('hung', None) after ``timeout_s`` (None/<=0 = no timeout).
+    A call blocked inside PJRT cannot be cancelled — callers must treat
+    'hung' as fatal for that backend, never retry in-process."""
+    import threading
+
+    out: list = []
+
+    def run():
+        try:
+            out.append(("ok", fn()))
+        except Exception as e:  # noqa: BLE001 — caller classifies
+            out.append(("error", e))
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout_s if timeout_s and timeout_s > 0 else None)
+    return out[0] if out else ("hung", None)
+
+
 def _init_backend(max_tries: int | None = None,
                   probe_timeout_s: float = 90.0):
     """Initialize the JAX backend with retry/backoff (round-1 failure:
@@ -61,8 +82,6 @@ def _init_backend(max_tries: int | None = None,
     and a hung probe must surface as a parseable error line, not a driver
     timeout.  Returns the device list; raises RuntimeError when every
     attempt is exhausted."""
-    import threading
-
     import jax
     import jax.extend.backend  # registers jax.extend (clear_backends)
 
@@ -70,20 +89,12 @@ def _init_backend(max_tries: int | None = None,
         max_tries = int(os.environ.get("GOSSIP_BENCH_MAX_TRIES", "5"))
     last_err: list = [None]
     for attempt in range(max_tries):
-        box: list = []
-
-        def probe():
-            try:
-                box.append(jax.devices())
-            except Exception as e:  # noqa: BLE001 — report any init error
-                last_err[0] = e
-
-        t = threading.Thread(target=probe, daemon=True)
-        t.start()
-        t.join(probe_timeout_s)
-        if box and box[0]:
-            return box[0]
-        if t.is_alive():
+        status, value = _call_with_timeout(jax.devices, probe_timeout_s)
+        if status == "ok" and value:
+            return value
+        if status == "error":
+            last_err[0] = value
+        if status == "hung":
             # The probe thread is stuck inside PJRT client creation; no
             # in-process retry can help (the hung init holds the backend
             # lock).  Bail out — main() decides whether a CPU-subprocess
@@ -206,11 +217,23 @@ def _bench_aligned(n, n_msgs, degree, mode):
     steady_rounds = int(os.environ.get(
         "GOSSIP_BENCH_STEADY_ROUNDS", "256" if on_tpu else "0"))
     if steady_rounds > 0:
-        res = sim.run(steady_rounds, warmup=True)
-        ms = res.wall_s / steady_rounds * 1e3
-        steady = {"steady_ms_per_round": round(ms, 3),
-                  "steady_rounds": steady_rounds,
-                  "device_est_s": round(ms * rounds / 1e3, 4)}
+        # The scan runs AFTER the headline measurement but BEFORE the
+        # result line prints — a tunnel death here must degrade to a
+        # line without steady fields, never to no line at all.  The
+        # hung call can't be cancelled (it's blocked in PJRT), so it
+        # runs under _call_with_timeout (<=0 disables the timeout).
+        status, value = _call_with_timeout(
+            lambda: sim.run(steady_rounds, warmup=True).wall_s,
+            float(os.environ.get("GOSSIP_BENCH_STEADY_TIMEOUT_S", "420")))
+        if status == "ok":
+            ms = value / steady_rounds * 1e3
+            steady = {"steady_ms_per_round": round(ms, 3),
+                      "steady_rounds": steady_rounds,
+                      "device_est_s": round(ms * rounds / 1e3, 4)}
+        else:
+            print(f"[bench] steady scan {status}"
+                  + (f" ({value})" if status == "error" else "")
+                  + "; omitting steady fields", file=sys.stderr)
     extras = {
         "liveness_every": liveness_every,
         "roll_groups": roll_groups,
