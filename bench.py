@@ -50,6 +50,15 @@ MAX_ROUNDS = 128
 TPU_PLATFORMS = ("tpu", "axon")
 
 
+def _check_every() -> int:
+    """GOSSIP_BENCH_CHECK_EVERY clamped to [1, MAX_ROUNDS] — a K that
+    never fits under MAX_ROUNDS would silently run the per-round tail
+    while the row claims K, and 0 (a natural "off" spelling) must mean
+    per-round, not a crash.  One definition for both engines."""
+    return max(1, min(int(os.environ.get("GOSSIP_BENCH_CHECK_EVERY",
+                                         "1")), MAX_ROUNDS))
+
+
 def _call_with_timeout(fn, timeout_s: float | None):
     """Run ``fn`` on a daemon thread; returns ('ok', value), ('error',
     exc), or ('hung', None) after ``timeout_s`` (None/<=0 = no timeout).
@@ -168,11 +177,7 @@ def _bench_aligned(n, n_msgs, degree, mode):
     # check_every): the census is a per-round sync barrier; K>1 checks
     # after each K-round chunk, may overshoot by <K rounds (counted in
     # the reported wall/rounds — conservative, never flattering).
-    # clamped to [1, MAX_ROUNDS]: a K that never fits under MAX_ROUNDS
-    # would silently run the per-round tail while the row claims K, and
-    # 0 (a natural "off" spelling) must mean per-round, not a crash
-    check_every = max(1, min(int(os.environ.get("GOSSIP_BENCH_CHECK_EVERY",
-                                                "1")), MAX_ROUNDS))
+    check_every = _check_every()
     t0 = time.perf_counter()
     topo = build_aligned(seed=0, n=n, n_slots=degree,
                          degree_law="powerlaw", roll_groups=roll_groups,
@@ -222,9 +227,13 @@ def _bench_aligned(n, n_msgs, degree, mode):
         # line without steady fields, never to no line at all.  The
         # hung call can't be cancelled (it's blocked in PJRT), so it
         # runs under _call_with_timeout (<=0 disables the timeout).
+        try:
+            steady_tmo = float(os.environ.get(
+                "GOSSIP_BENCH_STEADY_TIMEOUT_S", "420"))
+        except ValueError:
+            steady_tmo = 420.0    # malformed env must not cost the line
         status, value = _call_with_timeout(
-            lambda: sim.run(steady_rounds, warmup=True).wall_s,
-            float(os.environ.get("GOSSIP_BENCH_STEADY_TIMEOUT_S", "420")))
+            lambda: sim.run(steady_rounds, warmup=True).wall_s, steady_tmo)
         if status == "ok":
             ms = value / steady_rounds * 1e3
             steady = {"steady_ms_per_round": round(ms, 3),
@@ -266,13 +275,15 @@ def _bench_edges(n, n_msgs, degree, mode):
     sim = Simulator(topo=topo, n_msgs=n_msgs, mode=mode,
                     churn=ChurnConfig(rate=0.05, kill_round=1),
                     max_strikes=3, rewire=True, seed=0)
-    state, _t, rounds, wall = sim.run_to_coverage(target=TARGET_COV,
-                                                  max_rounds=MAX_ROUNDS)
+    check_every = _check_every()
+    state, _t, rounds, wall = sim.run_to_coverage(
+        target=TARGET_COV, max_rounds=MAX_ROUNDS, check_every=check_every)
     _check_converged(float(jax.device_get(coverage_of(state))), rounds)
     total_seen = int(jax.device_get(state.seen.sum()))
     import numpy as np
     n_edges = int(np.asarray(topo.edge_mask).sum())
-    return rounds, wall, total_seen, n_edges, graph_s, {}
+    extras = ({"check_every": check_every} if check_every > 1 else {})
+    return rounds, wall, total_seen, n_edges, graph_s, extras
 
 
 def _metric_name(n: int, mode: str, platform: str) -> str:

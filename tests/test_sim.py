@@ -41,6 +41,25 @@ def test_run_to_coverage_stops_early():
     assert float(coverage_of(st)) >= 0.99
 
 
+def test_run_to_coverage_check_every_parity():
+    """Edges-engine twin of the aligned test: K-chunked census runs the
+    same deterministic rounds (overshoot < K, never early), and
+    max_rounds stays a hard cap."""
+    topo = G.erdos_renyi(2, 512, avg_degree=8)
+    sim = Simulator(topo, n_msgs=4, mode="pushpull")
+    st1, _t1, r1, _w1 = sim.run_to_coverage(0.99, max_rounds=64)
+    for k in (2, 3):
+        stk, _tk, rk, _wk = sim.run_to_coverage(0.99, max_rounds=64,
+                                                check_every=k)
+        assert r1 <= rk < r1 + k
+        assert float(coverage_of(stk)) >= 0.99
+    _st5, _t5, r5, _w5 = sim.run_to_coverage(0.99, max_rounds=r1 - 1,
+                                             check_every=3)
+    assert r5 == r1 - 1
+    with pytest.raises(ValueError):
+        sim.run_to_coverage(0.99, check_every=0)
+
+
 def test_scan_matches_eager_loop():
     """lax.scan path must equal the eager per-round path bit-for-bit."""
     topo = G.erdos_renyi(3, 128, avg_degree=6)
