@@ -868,47 +868,14 @@ class AlignedSimulator:
         topo = self.topo if topo is None else topo
         cache_key = (target, max_rounds, check_every)
         if cache_key not in self._loop_cache:
-            from p2p_gossipprotocol_tpu.state import stagger_sched_end
+            from p2p_gossipprotocol_tpu.state import (build_coverage_loop,
+                                                      stagger_sched_end)
 
             sched_end = stagger_sched_end(self._n_honest,
                                           self.message_stagger)
-
-            def looped(st, tp):
-                def want_more(carry):
-                    st, tp, cov = carry
-                    return (cov < target) | (st.round < sched_end)
-
-                def round_body(carry):
-                    st, tp, _ = carry
-                    st, tp, metrics = self.step(st, tp)
-                    return st, tp, metrics["coverage"]
-
-                if check_every == 1:
-                    return jax.lax.while_loop(
-                        lambda c: want_more(c) & (c[0].round < max_rounds),
-                        round_body, (st, tp, jnp.float32(0)))
-
-                def chunk_body(carry):
-                    st, tp, _ = carry
-
-                    def chunk(c, _):
-                        s, t = c
-                        s, t, metrics = self.step(s, t)
-                        return (s, t), metrics["coverage"]
-
-                    (st, tp), covs = jax.lax.scan(
-                        chunk, (st, tp), None, length=check_every)
-                    return st, tp, covs[-1]
-
-                # chunked fast path: only chunks that fit under the cap
-                carry = jax.lax.while_loop(
-                    lambda c: (want_more(c)
-                               & (c[0].round + check_every <= max_rounds)),
-                    chunk_body, (st, tp, jnp.float32(0)))
-                # per-round tail (< K rounds) keeps max_rounds exact
-                return jax.lax.while_loop(
-                    lambda c: want_more(c) & (c[0].round < max_rounds),
-                    round_body, carry)
+            looped = build_coverage_loop(
+                self.step, target=target, max_rounds=max_rounds,
+                check_every=check_every, sched_end=sched_end)
             fn = jax.jit(looped)
             self._loop_cache[cache_key] = fn.lower(state, topo).compile()
         fn_c = self._loop_cache[cache_key]

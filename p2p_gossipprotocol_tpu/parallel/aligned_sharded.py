@@ -212,36 +212,34 @@ class AlignedShardedSimulator:
     def run_to_coverage(self, target: float = 0.99, max_rounds: int = 256,
                         state: AlignedState | None = None,
                         topo: AlignedTopology | None = None,
-                        warmup: bool = True):
+                        warmup: bool = True, check_every: int = 1):
         """(state, topo, rounds_run, wall_s) — the benchmark path, same
         contract as the unsharded engine (compile + first-execution upload
-        excluded, completion forced by a scalar device_get)."""
+        excluded, completion forced by a scalar device_get).
+
+        ``check_every=K`` is the same chunked-census option as
+        AlignedSimulator.run_to_coverage (overshoot < K counted in the
+        result, ``max_rounds`` a hard cap via the per-round tail) —
+        doubly relevant here, where the census is a cross-DEVICE barrier
+        (psum) per round, not just a reduction."""
         import time as _time
 
+        if check_every < 1:
+            raise ValueError("check_every must be >= 1")
         state = self.init_state() if state is None else state
         topo = self.shard_topo(topo)
-        cache_key = (target, max_rounds)
+        cache_key = (target, max_rounds, check_every)
         if cache_key not in self._loop_cache:
             st_spec, tp_spec, _ = self._specs()
 
-            from p2p_gossipprotocol_tpu.state import stagger_sched_end
+            from p2p_gossipprotocol_tpu.state import (build_coverage_loop,
+                                                      stagger_sched_end)
 
             sched_end = stagger_sched_end(self._n_honest,
                                           self.message_stagger)
-
-            def looped(st, tp):
-                def cond(carry):
-                    st, tp, cov = carry
-                    return (((cov < target) | (st.round < sched_end))
-                            & (st.round < max_rounds))
-
-                def body(carry):
-                    st, tp, _ = carry
-                    st, tp, metrics = self._step_local(st, tp)
-                    return st, tp, metrics["coverage"]
-
-                return jax.lax.while_loop(cond, body,
-                                          (st, tp, jnp.float32(0)))
+            looped = build_coverage_loop(
+                self._step_local, target=target, max_rounds=max_rounds,
+                check_every=check_every, sched_end=sched_end)
 
             fn = jax.jit(jax.shard_map(
                 looped, mesh=self.mesh,

@@ -387,38 +387,32 @@ class ShardedSimulator:
 
     def run_to_coverage(self, target: float = 0.99, max_rounds: int = 256,
                         state: GossipState | None = None,
-                        warmup: bool = True):
+                        warmup: bool = True, check_every: int = 1):
         """while_loop until coverage ≥ target (the benchmark path).
         Returns (state, stopo, rounds_run, wall_seconds); compile time and
-        (with ``warmup``) first-execution program upload are excluded."""
+        (with ``warmup``) first-execution program upload are excluded.
+        ``check_every`` is the shared chunked-census option
+        (state.build_coverage_loop)."""
         import time as _time
 
+        if check_every < 1:
+            raise ValueError("check_every must be >= 1")
         state = self.init_state() if state is None else state
         stopo = self.stopo
 
-        cache_key = (target, max_rounds)
+        cache_key = (target, max_rounds, check_every)
         if cache_key not in self._loop_cache:
             st_spec, tp_spec, _ = self._specs()
             from jax.sharding import PartitionSpec as P
 
-            from p2p_gossipprotocol_tpu.state import stagger_sched_end
+            from p2p_gossipprotocol_tpu.state import (build_coverage_loop,
+                                                      stagger_sched_end)
 
             sched_end = stagger_sched_end(self._n_honest,
                                           self.message_stagger)
-
-            def looped(st, tp):
-                def cond(carry):
-                    st, tp, cov = carry
-                    return (((cov < target) | (st.round < sched_end))
-                            & (st.round < max_rounds))
-
-                def body(carry):
-                    st, tp, _ = carry
-                    st, tp, metrics = self._step_local(st, tp)
-                    return st, tp, metrics["coverage"]
-
-                return jax.lax.while_loop(cond, body,
-                                          (st, tp, jnp.float32(0)))
+            looped = build_coverage_loop(
+                self._step_local, target=target, max_rounds=max_rounds,
+                check_every=check_every, sched_end=sched_end)
 
             fn = jax.jit(jax.shard_map(
                 looped, mesh=self.mesh,

@@ -102,6 +102,66 @@ def stagger_sched_end(n_honest: int, stagger: int) -> int:
     return (n_honest - 1) * stagger + 1 if stagger > 0 else 0
 
 
+def build_coverage_loop(step_fn, *, target: float, max_rounds: int,
+                        check_every: int, sched_end):
+    """ONE definition of the run-to-coverage device loop, shared by
+    every engine — edges (sim.Simulator), single-chip aligned, the 1-D
+    sharded pair, and the 2-D mesh — which differ only in ``step_fn``
+    (``(state, topo) -> (state, topo, metrics)``).  Returns
+    ``looped(state, topo) -> (state, topo, cov)``; lives here (with
+    :func:`stagger_sched_end`, its only companion input) so no engine
+    has to import a sibling engine for it.
+
+    Semantics (pinned by every engine's parity tests): stop when the
+    census coverage reaches ``target`` AND the stagger schedule has
+    ended; ``check_every=K`` evaluates that condition once per K-round
+    ``lax.scan`` chunk (the census is a sync barrier — cross-device on
+    the sharded engines), so convergence may overshoot by < K rounds
+    (the extra rounds are counted in the carried state, keeping the
+    reported time conservative); ``max_rounds`` stays a HARD cap — the
+    chunked loop only takes chunks that fit, and a per-round tail loop
+    finishes the remainder exactly."""
+
+    def looped(st, tp):
+        def want_more(carry):
+            st, tp, cov = carry
+            return (cov < target) | (st.round < sched_end)
+
+        def round_body(carry):
+            st, tp, _ = carry
+            st, tp, metrics = step_fn(st, tp)
+            return st, tp, metrics["coverage"]
+
+        if check_every == 1:
+            return jax.lax.while_loop(
+                lambda c: want_more(c) & (c[0].round < max_rounds),
+                round_body, (st, tp, jnp.float32(0)))
+
+        def chunk_body(carry):
+            st, tp, _ = carry
+
+            def chunk(c, _):
+                s, t = c
+                s, t, metrics = step_fn(s, t)
+                return (s, t), metrics["coverage"]
+
+            (st, tp), covs = jax.lax.scan(
+                chunk, (st, tp), None, length=check_every)
+            return st, tp, covs[-1]
+
+        # chunked fast path: only chunks that fit under the cap
+        carry = jax.lax.while_loop(
+            lambda c: (want_more(c)
+                       & (c[0].round + check_every <= max_rounds)),
+            chunk_body, (st, tp, jnp.float32(0)))
+        # per-round tail (< K rounds) keeps max_rounds exact
+        return jax.lax.while_loop(
+            lambda c: want_more(c) & (c[0].round < max_rounds),
+            round_body, carry)
+
+    return looped
+
+
 def init_gossip_state(topo: Topology, n_msgs: int, key: jax.Array,
                       sources: jax.Array | None = None,
                       byzantine_fraction: float = 0.0,

@@ -69,3 +69,11 @@ def test_2d_run_to_coverage(devices8):
     assert r2 == ru
     np.testing.assert_array_equal(np.asarray(st2.seen_w),
                                   np.asarray(stu.seen_w))
+    # chunked census on the 2-D mesh: bitwise vs the unsharded chunked run
+    stk, _tk, rk, _ = s2.run_to_coverage(0.99, max_rounds=64,
+                                         check_every=2)
+    stuk, _tu, ruk, _ = su.run_to_coverage(0.99, max_rounds=64,
+                                           check_every=2)
+    assert rk == ruk and ru <= rk < ru + 2
+    np.testing.assert_array_equal(np.asarray(stk.seen_w),
+                                  np.asarray(stuk.seen_w))

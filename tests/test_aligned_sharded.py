@@ -82,6 +82,17 @@ def test_run_to_coverage_sharded(devices8, topo8):
     assert rounds == rounds_u
     np.testing.assert_array_equal(np.asarray(st.seen_w),
                                   np.asarray(st_u.seen_w))
+    # chunked census: same deterministic stream, bounded overshoot,
+    # bitwise-equal to the unsharded chunked run
+    st_k, _tk, rounds_k, _wk = sim.run_to_coverage(0.99, max_rounds=64,
+                                                   check_every=3)
+    assert rounds <= rounds_k < rounds + 3
+    st_uk, _t, rounds_uk, _w2 = AlignedSimulator(
+        topo=topo8, **KW).run_to_coverage(0.99, max_rounds=64,
+                                          check_every=3)
+    assert rounds_k == rounds_uk
+    np.testing.assert_array_equal(np.asarray(st_k.seen_w),
+                                  np.asarray(st_uk.seen_w))
 
 
 def test_shard_mismatch_raises(devices8):

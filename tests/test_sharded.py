@@ -84,6 +84,11 @@ def test_sharded_coverage_reaches_target(topo, devices8):
     st, tp, rounds, wall = sim.run_to_coverage(target=0.99, max_rounds=64)
     assert 0 < rounds < 64
     assert wall > 0
+    # chunked census (shared state.build_coverage_loop): same stream,
+    # bounded overshoot
+    _stk, _tk, rounds_k, _wk = sim.run_to_coverage(
+        target=0.99, max_rounds=64, check_every=3)
+    assert rounds <= rounds_k < rounds + 3
 
 
 def test_sharded_pull_mode_runs(topo, devices8):
