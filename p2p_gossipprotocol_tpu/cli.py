@@ -108,6 +108,9 @@ def _run_sim(sim, rounds, args):
 
 
 def _run_jax(cfg: NetworkConfig, args) -> int:
+    # build_simulator probes the backend hang-proof first
+    # (engines.probe_backend): a dead TPU tunnel degrades to a labeled
+    # CPU run instead of freezing the CLI in backend init.
     from p2p_gossipprotocol_tpu.engines import build_simulator
     from p2p_gossipprotocol_tpu.utils import metrics as metrics_lib
 

@@ -30,6 +30,79 @@ their way (the CLI prints to stderr and exits 1, the facade propagates).
 
 from __future__ import annotations
 
+import os
+import subprocess
+import sys
+
+# memoized probe verdict: [fell_back_to_cpu] once decided (module-level
+# — one probe per process, like the backend state it guards)
+_PROBE_STATE: list = []
+
+
+def probe_backend() -> bool:
+    """Hang-proof accelerator check before this process's first device
+    use — shared by the CLI and the wrapper facade (every simulation
+    entry point goes through :func:`build_simulator`).
+
+    In the tunneled-TPU environment, backend init BLOCKS IN C when the
+    tunnel is down; pinning ``JAX_PLATFORMS=cpu`` in the environment
+    does not help (the registered TPU plugin is still queried during
+    discovery), and once ANY thread of a process has hung in init the
+    backend lock is poisoned — an in-process CPU fallback blocks too
+    (measured).  So the probe runs in a SUBPROCESS (inheriting the
+    full environment, so it fails exactly like this process would),
+    and on hang/failure this process pins CPU via ``jax.config``
+    BEFORE its own first device use — the one ordering that skips the
+    plugin — with a clear message instead of a frozen entry point.
+
+    ``GOSSIP_NO_BACKEND_PROBE=1`` skips it; so does an already
+    initialized in-process backend (too late to matter, and the common
+    case for library users and the test suite).  The verdict is
+    memoized — constructing several simulators before the first device
+    use must not pay the hang timeout once per construction.
+
+    Returns True when the CPU fallback was applied (this call or a
+    previous one), so callers can adapt (build_simulator clamps a
+    multi-device mesh request to what the fallback platform has)."""
+    import jax
+
+    if os.environ.get("GOSSIP_NO_BACKEND_PROBE"):
+        return False
+    if (os.environ.get("JAX_PLATFORMS") == "cpu"
+            and not os.environ.get("PALLAS_AXON_POOL_IPS")):
+        # explicitly CPU-pinned with no tunneled plugin registered: no
+        # hang hazard, so the common test/dev path pays nothing
+        return False
+    if _PROBE_STATE:
+        return _PROBE_STATE[0]
+    try:  # already initialized — nothing to decide
+        if jax._src.xla_bridge._backends:  # noqa: SLF001
+            _PROBE_STATE.append(False)
+            return False
+    except Exception:  # noqa: BLE001 — private API moved: just probe
+        pass
+    try:
+        # 90 s default = bench._init_backend's probe budget: a cold
+        # tunneled PJRT init can take ~30 s when HEALTHY, and wrongly
+        # pinning a TPU user to CPU (memoized!) is worse than waiting
+        tmo = float(os.environ.get("GOSSIP_PROBE_TIMEOUT_S", "90"))
+    except ValueError:
+        tmo = 90.0    # malformed knob must not take down an entry point
+    try:
+        ok = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True, timeout=tmo).returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        ok = False
+    if not ok:
+        print("[gossip] accelerator backend unavailable (init hung or "
+              "failed) — simulating on CPU instead (results are "
+              "platform-independent; only speed differs)",
+              file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+    _PROBE_STATE.append(not ok)
+    return not ok
+
 
 def build_simulator(cfg, *, n_peers: int | None = None,
                     mesh_devices: int | None = None,
@@ -42,9 +115,25 @@ def build_simulator(cfg, *, n_peers: int | None = None,
     only) collects any configured value the engine had to reduce —
     surfaced by every caller, never silent.
     """
+    fell_back = probe_backend()
     mesh_devices = (cfg.mesh_devices if mesh_devices is None
                     else mesh_devices)
     msg_shards = cfg.msg_shards if msg_shards is None else msg_shards
+    if fell_back and mesh_devices > 1:
+        # the promised CPU run must actually RUN: clamp a multi-device
+        # mesh request to what the fallback platform has, loudly
+        import jax
+
+        avail = len(jax.devices())
+        if mesh_devices > avail:
+            if clamps is not None:
+                clamps.append(f"mesh_devices {mesh_devices} -> {avail} "
+                              "(accelerator unavailable, CPU fallback)")
+            mesh_devices = avail
+            # drop plane sharding rather than risk a non-divisor pair
+            # (msg_shards must divide mesh_devices) — the fallback's
+            # promise is that the run HAPPENS
+            msg_shards = 0
     n_shards = max(1, mesh_devices)
 
     if n_shards > 1:
