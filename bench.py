@@ -30,7 +30,14 @@ outside its dissemination path too.
 Env knobs: GOSSIP_BENCH_PEERS (default 1_048_576), GOSSIP_BENCH_MSGS (16),
 GOSSIP_BENCH_DEGREE (16), GOSSIP_BENCH_MODE (pushpull),
 GOSSIP_BENCH_ENGINE (aligned | edges), GOSSIP_BENCH_PLATFORM (pin a
-backend), GOSSIP_BENCH_FALLBACK_PEERS (256k), GOSSIP_BENCH_NO_FALLBACK.
+backend), GOSSIP_BENCH_FALLBACK_PEERS (256k), GOSSIP_BENCH_NO_FALLBACK,
+GOSSIP_BENCH_CHURN (0.05), GOSSIP_BENCH_LIVENESS_EVERY (3),
+GOSSIP_BENCH_ROLL_GROUPS (4), GOSSIP_BENCH_STAGGER (0),
+GOSSIP_BENCH_BLOCK_PERM (0), GOSSIP_BENCH_FUSE_UPDATE (0),
+GOSSIP_BENCH_PULL_WINDOW (1 when roll-grouped pushpull; falls back to
+off when the overlay can't support it), GOSSIP_BENCH_CHECK_EVERY (1,
+clamped to [1, MAX_ROUNDS]), GOSSIP_BENCH_STEADY_ROUNDS (256 on TPU,
+0 elsewhere), GOSSIP_BENCH_STEADY_TIMEOUT_S (420).
 """
 
 from __future__ import annotations
