@@ -37,7 +37,13 @@ GOSSIP_BENCH_BLOCK_PERM (0), GOSSIP_BENCH_FUSE_UPDATE (0),
 GOSSIP_BENCH_PULL_WINDOW (1 when roll-grouped pushpull; falls back to
 off when the overlay can't support it), GOSSIP_BENCH_CHECK_EVERY (1,
 clamped to [1, MAX_ROUNDS]), GOSSIP_BENCH_STEADY_ROUNDS (256 on TPU,
-0 elsewhere), GOSSIP_BENCH_STEADY_TIMEOUT_S (420).
+0 elsewhere), GOSSIP_BENCH_STEADY_TIMEOUT_S (420),
+GOSSIP_BENCH_FAULTS (a faults.FaultPlan spec, e.g. "drop=0.2"; also
+reachable as ``bench.py --faults SPEC``) — the run executes under the
+fault plan and the result line carries a ``faults`` column, so
+BENCH_*.json rows can track fault-plane overhead and
+coverage-under-faults over time.  Unset/empty = no faults (the column
+reads null).
 """
 
 from __future__ import annotations
@@ -55,6 +61,18 @@ MAX_ROUNDS = 128
 # The real chip registers as the experimental "axon" PJRT platform, not
 # "tpu" (BENCH_r02 tail; aligned.py treats both as the TPU path).
 TPU_PLATFORMS = ("tpu", "axon")
+
+
+def _fault_plan():
+    """The run's FaultPlan (or None) from GOSSIP_BENCH_FAULTS — parsed
+    once per process; a bad spec must die loudly BEFORE the measurement,
+    not as a mid-run trace error."""
+    spec = os.environ.get("GOSSIP_BENCH_FAULTS", "").strip()
+    if not spec:
+        return None
+    from p2p_gossipprotocol_tpu.faults import FaultPlan
+
+    return FaultPlan.parse(spec)
 
 
 def _check_every() -> int:
@@ -87,20 +105,58 @@ def _call_with_timeout(fn, timeout_s: float | None):
     return out[0] if out else ("hung", None)
 
 
+def _probe_backend_subprocess(probe_timeout_s: float) -> bool:
+    """Hang-PROOF accelerator check: run ``jax.devices()`` (under this
+    process's platform pin) in a subprocess that a timeout can actually
+    kill.  The old thread-based probe detected a hang but left the
+    process poisoned — a backend init stuck in C (e.g. libtpu's GCP
+    metadata fetch retrying forever off-cloud) blocks interpreter
+    shutdown, so the parseable error line never flushed and the driver
+    saw a silent 420 s timeout (this was THE tier-1 suite killer: the
+    two TPU-pinned bench tests each ate their full subprocess timeout).
+    Same discipline as engines.probe_backend; cpu pins skip the probe
+    entirely, so the common test/dev path pays nothing."""
+    platform = os.environ.get("GOSSIP_BENCH_PLATFORM", "")
+    if platform == "cpu" or (not platform
+                             and os.environ.get("JAX_PLATFORMS") == "cpu"):
+        return True
+    pin = (f"jax.config.update('jax_platforms', {platform!r}); "
+           if platform else "")
+    code = f"import jax; {pin}assert jax.devices()"
+    try:
+        return subprocess.run([sys.executable, "-c", code],
+                              capture_output=True,
+                              timeout=probe_timeout_s).returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
 def _init_backend(max_tries: int | None = None,
-                  probe_timeout_s: float = 90.0):
+                  probe_timeout_s: float | None = None):
     """Initialize the JAX backend with retry/backoff (round-1 failure:
     one-shot init died with "Unable to initialize backend 'axon':
     UNAVAILABLE" and the bench emitted a raw traceback, BENCH_r01 rc=1).
 
-    Each probe runs ``jax.devices()`` on a daemon thread with a timeout —
-    backend init can HANG (not just fail) when the TPU tunnel is down,
-    and a hung probe must surface as a parseable error line, not a driver
-    timeout.  Returns the device list; raises RuntimeError when every
-    attempt is exhausted."""
+    A SUBPROCESS probe (:func:`_probe_backend_subprocess`) gates the
+    in-process init: when backend init hangs in C, no thread of THIS
+    process may ever touch it — a hung in-process probe poisons
+    interpreter shutdown and the result line is lost.  After the gate,
+    ``jax.devices()`` still runs on a watchdog thread (belt and braces
+    for an init that hangs only under the real client).  Returns the
+    device list; raises RuntimeError when every attempt is exhausted."""
     import jax
     import jax.extend.backend  # registers jax.extend (clear_backends)
 
+    if probe_timeout_s is None:
+        try:
+            probe_timeout_s = float(os.environ.get(
+                "GOSSIP_BENCH_PROBE_TIMEOUT_S", "90"))
+        except ValueError:
+            probe_timeout_s = 90.0
+    if not _probe_backend_subprocess(probe_timeout_s):
+        raise RuntimeError(
+            f"backend probe failed or hung within {probe_timeout_s}s "
+            "(subprocess probe; accelerator unavailable?)")
     if max_tries is None:
         max_tries = int(os.environ.get("GOSSIP_BENCH_MAX_TRIES", "5"))
     last_err: list = [None]
@@ -190,13 +246,16 @@ def _bench_aligned(n, n_msgs, degree, mode):
                          degree_law="powerlaw", roll_groups=roll_groups,
                          block_perm=block_perm)
     graph_s = time.perf_counter() - t0
+    plan = _fault_plan()
+
     def _mk_sim(pw):
         return AlignedSimulator(
             topo=topo, n_msgs=n_msgs, mode=mode,
             churn=ChurnConfig(rate=churn_rate, kill_round=1),
             max_strikes=3, liveness_every=liveness_every,
             message_stagger=stagger,
-            fuse_update=fuse_update, pull_window=pw, seed=0)
+            fuse_update=fuse_update, pull_window=pw, faults=plan,
+            seed=0)
 
     try:
         sim = _mk_sim(pull_window)
@@ -253,6 +312,7 @@ def _bench_aligned(n, n_msgs, degree, mode):
     extras = {
         "liveness_every": liveness_every,
         "roll_groups": roll_groups,
+        "faults": plan.to_spec() if plan else None,
         **({"message_stagger": stagger} if stagger else {}),
         **({"block_perm": True} if block_perm else {}),
         **({"fuse_update": True} if fuse_update else {}),
@@ -279,9 +339,10 @@ def _bench_edges(n, n_msgs, degree, mode):
     t0 = time.perf_counter()
     topo = graph.reference_powerlaw(seed=0, n=n, max_degree=degree)
     graph_s = time.perf_counter() - t0
+    plan = _fault_plan()
     sim = Simulator(topo=topo, n_msgs=n_msgs, mode=mode,
                     churn=ChurnConfig(rate=0.05, kill_round=1),
-                    max_strikes=3, rewire=True, seed=0)
+                    max_strikes=3, rewire=True, faults=plan, seed=0)
     check_every = _check_every()
     state, _t, rounds, wall = sim.run_to_coverage(
         target=TARGET_COV, max_rounds=MAX_ROUNDS, check_every=check_every)
@@ -289,7 +350,8 @@ def _bench_edges(n, n_msgs, degree, mode):
     total_seen = int(jax.device_get(state.seen.sum()))
     import numpy as np
     n_edges = int(np.asarray(topo.edge_mask).sum())
-    extras = ({"check_every": check_every} if check_every > 1 else {})
+    extras = {"faults": plan.to_spec() if plan else None,
+              **({"check_every": check_every} if check_every > 1 else {})}
     return rounds, wall, total_seen, n_edges, graph_s, extras
 
 
@@ -378,6 +440,20 @@ def _cpu_fallback(n, engine) -> int:
 
 
 def main() -> int:
+    # --faults SPEC rides into the env so the CPU-fallback subprocess
+    # (which re-execs with no argv) inherits the same plan — the
+    # fallback line's faults column must match the requested run's.
+    argv = sys.argv[1:]
+    if "--faults" in argv:
+        i = argv.index("--faults")
+        if i + 1 >= len(argv):
+            raise SystemExit("--faults needs a spec "
+                             "(e.g. --faults drop=0.2,delay=0.1)")
+        os.environ["GOSSIP_BENCH_FAULTS"] = argv[i + 1]
+    else:
+        for a in argv:
+            if a.startswith("--faults="):
+                os.environ["GOSSIP_BENCH_FAULTS"] = a.split("=", 1)[1]
     n = int(os.environ.get("GOSSIP_BENCH_PEERS", str(BASELINE_PEERS)))
     n_msgs = int(os.environ.get("GOSSIP_BENCH_MSGS", "16"))
     degree = int(os.environ.get("GOSSIP_BENCH_DEGREE", "16"))

@@ -10,6 +10,10 @@ the reference's JSON wire protocol for small-n interop.
 
 Layout:
   config        — network.txt parser (reference config.cpp semantics)
+  faults        — the unified fault-injection plane: one declarative
+                  FaultPlan (link drop, delay, partitions, crash/
+                  recovery) compiled to seed-deterministic masks for
+                  every engine + the socket wire (docs/ROBUSTNESS.md)
   info          — PeerInfo/Message data model + SHA-256 identity
   graph         — overlay construction: power-law fanout, ER, BA generators
   state         — simulation state pytrees; message plan / stagger schedule
@@ -34,5 +38,7 @@ Layout:
 __version__ = "0.1.0"
 
 from p2p_gossipprotocol_tpu.config import ConfigError, NetworkConfig, NodeInfo
+from p2p_gossipprotocol_tpu.faults import FaultPlan
 
-__all__ = ["NetworkConfig", "NodeInfo", "ConfigError", "__version__"]
+__all__ = ["NetworkConfig", "NodeInfo", "ConfigError", "FaultPlan",
+           "__version__"]

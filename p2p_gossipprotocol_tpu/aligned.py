@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
+from p2p_gossipprotocol_tpu import faults as faults_lib
 from p2p_gossipprotocol_tpu.liveness import ChurnConfig
 from p2p_gossipprotocol_tpu.ops.aligned_kernel import (LANES, gossip_pass,
                                                        liveness_pass,
@@ -441,15 +442,27 @@ class AlignedSimulator:
     #: roll, cutting its seen-plane stream from `streams` to 1 and its
     #: lane-table stream by D/window (docs/PERFORMANCE.md "pull-window
     #: restriction").  Needs a roll-grouped overlay (window >= 2).
-    #: Opt-in: it changes every pull trajectory (different draw
-    #: modulus), so it is an A/B knob, not a default.
+    #: Direct-construction default stays off (it changes every pull
+    #: trajectory — an A/B knob); the CONFIG default is now on
+    #: (config.py pull_window=1 + roll_groups=4, the measured-best
+    #: layout per the round-5 on-chip A/Bs), with from_config falling
+    #: back to the classic path when a scenario can't support it.
     pull_window: bool = False
+    #: declarative fault plan (faults.FaultPlan): per-link drop +
+    #: partition gates ride the kernels' in-register hash path,
+    #: relay delay and crash/recovery schedules the host-side masks —
+    #: all keyed on global ids, so faulted runs keep the bitwise
+    #: sharded-vs-unsharded parity contract.  None = no faults, and
+    #: the compiled round is exactly the pre-fault-plane program.
+    faults: object | None = None
     seed: int = 0
     interpret: bool | None = None   # None -> interpret unless on TPU
 
     def __post_init__(self):
         if self.n_msgs <= 0:
             raise ValueError("n_msgs must be positive")
+        if self.faults is not None:
+            self.faults.validate()
         if self.liveness_every < 1:
             raise ValueError("liveness_every must be >= 1")
         self.n_words = n_msg_words(self.n_msgs)
@@ -593,8 +606,14 @@ class AlignedSimulator:
                 f"(aligned engine packs <= {MAX_CONFIG_MSGS} messages "
                 "= 64 int32 planes)")
             n_msgs = MAX_CONFIG_MSGS
+        from p2p_gossipprotocol_tpu import faults as faults_lib
+
+        plan = faults_lib.plan_from_config(cfg)
+        # The plan's byzantine knob routes into the existing adversary
+        # machinery (sim.Simulator.from_config has the same merge rule).
+        byz = max(cfg.byzantine_fraction, plan.byzantine if plan else 0.0)
         n_honest = None
-        if cfg.byzantine_fraction > 0.0:
+        if byz > 0.0:
             n_junk = max(1, n_msgs // 4)
             if n_msgs + n_junk > MAX_CONFIG_MSGS:
                 clamps.append(
@@ -604,6 +623,19 @@ class AlignedSimulator:
                 n_msgs = MAX_CONFIG_MSGS - n_junk
             n_honest = n_msgs
             n_msgs = n_msgs + n_junk
+        # pull_window is DEFAULT-ON from the config surface (the
+        # measured-best layout, VERDICT round-5 item 1) but remains an
+        # optimization, not the scenario: when this configuration can't
+        # support it — push-only mode, an overlay that isn't roll-grouped
+        # with a >= 2-slot first group, or pure pull on a block-perm
+        # overlay (the single-cycle stall __post_init__ rejects) — fall
+        # back to the classic pull path instead of erroring the run.
+        pull_window = bool(cfg.pull_window)
+        if pull_window:
+            groups = cfg.roll_groups or 0
+            if (cfg.mode == "push" or not 1 <= groups <= n_slots // 2
+                    or (cfg.mode == "pull" and cfg.block_perm)):
+                pull_window = False
         # n_msgs shrinks the kernel's VMEM row block for wide message
         # sets; the fused update keeps twice the word-blocks resident,
         # so its row block is bounded by the HALVED budget directly
@@ -625,7 +657,7 @@ class AlignedSimulator:
         return cls(topo=topo, n_msgs=n_msgs, mode=cfg.mode,
                    fanout=cfg.fanout,
                    churn=ChurnConfig(rate=cfg.churn_rate),
-                   byzantine_fraction=cfg.byzantine_fraction,
+                   byzantine_fraction=byz,
                    n_honest_msgs=n_honest,
                    max_strikes=cfg.max_missed_pings,
                    # probe cadence from the config's own intervals: one
@@ -641,7 +673,9 @@ class AlignedSimulator:
                           else cfg.get_ping_interval()))),
                    message_stagger=cfg.message_stagger,
                    fuse_update=bool(cfg.fuse_update),
-                   pull_window=bool(cfg.pull_window),
+                   pull_window=pull_window,
+                   faults=(plan if plan and plan.engine_active()
+                           else None),
                    seed=cfg.prng_seed)
 
     # ------------------------------------------------------------------
@@ -977,6 +1011,43 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
     if sim.churn.rate > 0.0 or sim.churn.revive > 0.0:
         alive_b = churn_rows(k_churn, grows, alive_b, valid_b,
                              state.round, sim.churn)
+
+    # -- fault plane (faults.FaultPlan; None compiles the plain round) --
+    # Every fault draw is keyed on (plan seed, round, global row) — never
+    # the simulation's own key chain — so an unfaulted run's trajectory
+    # is untouched and faulted runs keep the bitwise sharded-vs-unsharded
+    # contract (per-global-row fold_ins + the kernels' global-id hash).
+    plan = sim.faults
+    fkey = None
+    if plan is not None and plan.engine_active():
+        fkey = faults_lib.round_key(plan, state.round)
+    if plan is not None and (plan.crash or plan.recover):
+        # Scheduled crash/recovery: real deaths/revivals — the liveness
+        # strikes below observe them, unlike partitions, which sever
+        # transfers only.  Padding rows can never revive (& valid_b).
+        alive_b = faults_lib.schedule_step(
+            plan, fkey, alive_b, valid_b, state.round,
+            lambda k: row_uniform(k, grows, (LANES,)))
+    defer_w = None
+    if (plan is not None and plan.delay > 0.0
+            and sim.mode in ("push", "pushpull")):
+        # Relay delay: a peer's push of its frontier slips one round
+        # (sender-side, per-peer — the synchronous-round model's delayed
+        # delivery); pull serves are unaffected (the peer's state is
+        # intact, only its relay is late).
+        u = row_uniform(jax.random.fold_in(fkey, faults_lib.TAG_DEFER),
+                        grows, (LANES,))
+        defer_w = jnp.where((u < plan.delay) & alive_b,
+                            jnp.int32(-1), jnp.int32(0))
+    kf = plan is not None and plan.kernel_active()
+    if kf:
+        # Per-link drop + partition gates, evaluated in-register inside
+        # the kernels (ops/aligned_kernel.py fault gate) — no HBM mask
+        # tensor exists.  Push and pull passes get decorrelated hash
+        # seeds (two passes = two independent uses of the same links).
+        gbase_f = grows[::topo.rowblk]
+        fmeta_push = faults_lib.kernel_meta(plan, state.round, 0)
+        fmeta_pull = faults_lib.kernel_meta(plan, state.round, 1)
     alive_w = jnp.where(alive_b, jnp.int32(-1), jnp.int32(0))
 
     strikes = state.strikes
@@ -1066,8 +1137,13 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
 
     if fused:
         # the in-kernel send mask: -1 where the source is alive and
-        # honest (dead peers don't send; byzantine peers never relay)
+        # honest (dead peers don't send; byzantine peers never relay);
+        # the push pass additionally drops deferred relayers, while the
+        # pull pass keeps serving them (delay is a relay fault, not a
+        # state fault)
         src_ok = gather(alive_w & ~state.byz_w)
+        src_ok_push = (gather(alive_w & ~state.byz_w & ~defer_w)
+                       if defer_w is not None else src_ok)
     # In-kernel seen-update (sim.fuse_update): the FINAL pass of the
     # round takes the receiver's seen planes + receive mask and emits
     # (new, seen') straight from its VMEM-resident accumulator; in
@@ -1076,6 +1152,13 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
     fin = sim.fuse_update
     rmask_w = (topo.valid_w & alive_w) if fin else None
     new = seen = None
+    deferred_w = None
+    if defer_w is not None:
+        # The would-have-been relays a deferred peer holds back: they
+        # re-enter the frontier below, so the transfer lands one round
+        # late instead of never (flood-once would otherwise drop it).
+        deferred_w = (frontier_w & alive_w[None] & ~state.byz_w[None]
+                      & defer_w[None])
     if sim.mode in ("push", "pushpull"):
         # Dead peers don't send; byzantine peers never relay (suppression,
         # models/gossip.py:50-58) — both masked at the source words.
@@ -1083,6 +1166,8 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
             y = gather(frontier_w)
         else:
             send = frontier_w & alive_w[None] & ~state.byz_w[None]
+            if defer_w is not None:
+                send = send & ~defer_w[None]
             y = prow(gather(send))
         if sim.fanout > 0:
             # Rumor mongering: each peer listens on a random fanout-slot
@@ -1098,9 +1183,11 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
                            topo.subrolls, pull=False, fanout=sim.fanout,
                            shift=shift,
                            ytab=ytab_local if fused else None,
-                           src_ok=src_ok if fused else None,
+                           src_ok=src_ok_push if fused else None,
                            seen=seen_w if push_final else None,
                            rmask=rmask_w if push_final else None,
+                           fault_meta=fmeta_push if kf else None,
+                           gbase=gbase_f if kf else None,
                            rowblk=topo.rowblk,
                            interpret=sim.interpret)
         if push_final:
@@ -1134,6 +1221,8 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
                                        sim.mode == "pushpull" else None),
                              seen=seen_w if fin else None,
                              rmask=rmask_w,
+                             fault_meta=fmeta_pull if kf else None,
+                             gbase=gbase_f if kf else None,
                              rowblk=topo.rowblk,
                              interpret=sim.interpret)
         if fin:
@@ -1145,6 +1234,14 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
         recv = recv & topo.valid_w[None] & alive_w[None]
         new = recv & ~seen_w
         seen = seen_w | new
+        # Receipts of already-seen messages — the degradation metric
+        # link faults inflate (every redundant transfer still landed).
+        # The fused path never materializes recv (its kernel emits
+        # (new, seen') straight from VMEM), so it reports 0 there.
+        redeliveries = _pair_total(msg_reduce(_popcount_pair(
+            recv & seen_w)))
+    else:
+        redeliveries = jnp.float32(0)
     # In this engine deliveries == frontier bits by construction (every
     # first receipt enters the next frontier); both keys are kept for
     # surface parity with sim.Simulator's metric dict.  Totals ride the
@@ -1187,9 +1284,11 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
                 / (n_ok * n_cols))
     live = _pair_total(reduce(_popcount_pair(
         alive_w & topo.valid_w))) / 32.0
-    state = AlignedState(seen_w=seen, frontier_w=new, alive_b=alive_b,
+    frontier = new if deferred_w is None else new | deferred_w
+    state = AlignedState(seen_w=seen, frontier_w=frontier, alive_b=alive_b,
                          byz_w=state.byz_w, strikes=strikes, key=key,
                          round=state.round + 1)
     return state, topo, {"coverage": coverage, "deliveries": deliveries,
                          "frontier_size": deliveries,
-                         "live_peers": live, "evictions": n_evict}
+                         "live_peers": live, "evictions": n_evict,
+                         "redeliveries": redeliveries}

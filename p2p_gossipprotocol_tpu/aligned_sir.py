@@ -99,9 +99,16 @@ class AlignedSIRSimulator:
         shared by the CLI's ``--mode sir --engine aligned`` and the
         wrapper facade (mirrors AlignedSimulator.from_config; same
         resolve_overlay clamping contract)."""
+        from p2p_gossipprotocol_tpu import faults as faults_lib
         from p2p_gossipprotocol_tpu.aligned import (build_aligned,
                                                     resolve_overlay)
 
+        plan = faults_lib.plan_from_config(cfg)
+        if plan is not None and plan.engine_active():
+            raise ValueError(
+                "fault plans apply to the gossip modes — the SIR model "
+                "has no message-transfer path to fault (use churn_rate "
+                "for its peer-level failures)")
         clamps = clamps if clamps is not None else []
         n, law, n_slots = resolve_overlay(cfg, n_peers=n_peers,
                                           clamps=clamps)

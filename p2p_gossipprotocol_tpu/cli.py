@@ -68,6 +68,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "(Aligned2DShardedSimulator); 0 = peers only; "
                         "default: the msg_shards= config key")
     p.add_argument("--target-coverage", type=float, default=0.99)
+    p.add_argument("--fault-plan", default=None, metavar="SPEC",
+                   help="unified fault injection (faults.FaultPlan), "
+                        "e.g. 'drop=0.2,delay=0.1,partition=4:12,"
+                        "groups=2,crash=3:0.3,recover=16:0.5'; "
+                        "overrides the fault_* config keys.  jax mode: "
+                        "seed-deterministic link/partition/crash masks "
+                        "in every engine; socket mode: wire-level "
+                        "drop/delay/duplication")
     p.add_argument("--local-ip", default=None)
     p.add_argument("--local-port", type=int, default=None)
     p.add_argument("--wire-format", choices=["json", "framed"],
@@ -311,6 +319,16 @@ def main(argv: list[str] | None = None) -> int:
     if args.engine:
         cfg.engine = args.engine
     args.engine = cfg.engine
+    if args.fault_plan:
+        from p2p_gossipprotocol_tpu import faults as faults_lib
+
+        try:
+            plan = faults_lib.apply_spec_to_config(cfg, args.fault_plan)
+        except ValueError as e:
+            print(f"Error: bad --fault-plan: {e}", file=sys.stderr)
+            return 1
+        if not args.quiet:
+            print(f"[faults] {plan.to_spec() or 'none'}", file=sys.stderr)
     # flags override the config keys; absent flags fall back to them, so
     # a config file alone selects any engine (same table as the facade)
     if args.mesh_devices is None:

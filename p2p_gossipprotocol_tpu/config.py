@@ -136,6 +136,10 @@ _SIM_INT_KEYS = {
     # reference's behavior — its flood-once push loses every message
     # generated before a connection existed, peer.cpp:297-318).
     "anti_entropy_interval": "anti_entropy_interval",
+    # Fault plane (faults.FaultPlan): peers per partition group (power
+    # of two <= 128) and the plan's own PRNG seed.
+    "fault_partition_groups": "fault_partition_groups",
+    "fault_seed": "fault_seed",
 }
 _SIM_FLOAT_KEYS = {
     "er_p": "er_p",
@@ -144,6 +148,13 @@ _SIM_FLOAT_KEYS = {
     "powerlaw_alpha": "powerlaw_alpha",
     "sir_beta": "sir_beta",
     "sir_gamma": "sir_gamma",
+    # Fault plane probabilities, all in [0, 1): per-round per-link drop,
+    # per-round per-peer relay delay, wire-level duplication (socket
+    # backend), and the unified entry to the byzantine machinery.
+    "fault_link_drop": "fault_link_drop",
+    "fault_delay": "fault_delay",
+    "fault_duplicate": "fault_duplicate",
+    "fault_byzantine": "fault_byzantine",
 }
 _SIM_STR_KEYS = {
     "local_ip": "local_ip",
@@ -157,6 +168,11 @@ _SIM_STR_KEYS = {
     # the CLI alike, so a reference-parity deployment can opt into the
     # scale path without leaving the config file.
     "engine": "engine",
+    # Fault plane schedules: partition windows "start:heal[+start:heal]"
+    # and crash/recover schedules "round:fraction[+round:fraction]".
+    "fault_partition": "fault_partition",
+    "fault_crash": "fault_crash",
+    "fault_recover": "fault_recover",
 }
 
 
@@ -185,10 +201,18 @@ class NetworkConfig:
         self.ba_m = 4
         self.er_p = 0.0
         self.fanout = 0
-        self.roll_groups = 0           # aligned engine; 0 = per-slot rolls
+        # Measured-best aligned-engine defaults (round-5 on-chip A/Bs,
+        # docs/PERFORMANCE.md "Default path == measured-best path"):
+        # grouped block rolls + windowed pull are ON by default —
+        # -29.5% steady-state ms/round at 1M — and from_config falls
+        # back to the classic pull path when a scenario can't support
+        # the window (push-only mode, un-groupable overlays).
+        # block_perm/fuse_update stay opt-in (a wash / measured
+        # negative at typical widths).
+        self.roll_groups = 4           # aligned engine; 0 = per-slot rolls
         self.block_perm = 0            # aligned engine; 1 = fused overlay
         self.fuse_update = 0           # aligned engine; 1 = in-kernel seen|new
-        self.pull_window = 0           # aligned engine; 1 = windowed pull
+        self.pull_window = 1           # aligned engine; 0 = classic pull
         self.rounds = 0
         self.message_stagger = 0       # 0 = all rumors at round 0
         self.mesh_devices = 0          # 0/1 = single device
@@ -200,6 +224,16 @@ class NetworkConfig:
         self.sir_gamma = 0.1
         self.prng_seed = 0
         self.anti_entropy_interval = 0   # socket mode; 0 = off
+        # Fault plane (faults.FaultPlan; all off by default)
+        self.fault_link_drop = 0.0
+        self.fault_delay = 0.0
+        self.fault_duplicate = 0.0
+        self.fault_byzantine = 0.0
+        self.fault_partition = ""        # "start:heal[+start:heal...]"
+        self.fault_partition_groups = 2
+        self.fault_crash = ""            # "round:frac[+round:frac...]"
+        self.fault_recover = ""
+        self.fault_seed = 0
         self._load_config()
         self._validate_config()
 
@@ -344,6 +378,15 @@ class NetworkConfig:
             raise ConfigError("churn_rate must be in [0, 1)")
         if not (0.0 <= self.byzantine_fraction < 1.0):
             raise ConfigError("byzantine_fraction must be in [0, 1)")
+        # Fault-plane keys: one validation path with the CLI's
+        # --fault-plan spec (faults.FaultPlan.validate), surfaced as
+        # ConfigError like every other key.
+        from p2p_gossipprotocol_tpu import faults as faults_lib
+
+        try:
+            faults_lib.plan_from_config(self)
+        except ValueError as e:
+            raise ConfigError(str(e))
 
     # -- helpers ----------------------------------------------------------
     def get_random_seeds(self, count: int, rng: random.Random | None = None

@@ -208,7 +208,7 @@ def build_simulator(cfg, *, n_peers: int | None = None,
             liveness_every=sim.liveness_every,
             message_stagger=sim.message_stagger,
             fuse_update=sim.fuse_update, pull_window=sim.pull_window,
-            seed=sim.seed)
+            faults=sim.faults, seed=sim.seed)
         if msg_shards > 1:
             # 2-D mesh: message planes x peer rows (the SP analogue,
             # parallel/aligned_2d.py)
@@ -238,6 +238,7 @@ def build_simulator(cfg, *, n_peers: int | None = None,
             byzantine_fraction=sim.byzantine_fraction,
             n_honest_msgs=sim.n_honest_msgs,
             max_strikes=sim.max_strikes,
-            message_stagger=sim.message_stagger, seed=sim.seed)
+            message_stagger=sim.message_stagger, faults=sim.faults,
+            seed=sim.seed)
         return sim, f"edges-sharded-{n_shards}"
     return sim, "edges"

@@ -1,0 +1,376 @@
+"""Unified fault-injection plane.
+
+The reference's only failure story is "evict a peer that misses 3 pings"
+(SURVEY.md §2-C10), and until now this repo modelled just that plus
+whole-peer churn kills and byzantine suppression.  A production gossip
+fabric degrades through *link*-level loss, delayed delivery, partitions,
+and peers that come back — epidemic dissemination is famously tolerant of
+exactly these faults, and this module makes that tolerance measurable.
+
+One declarative :class:`FaultPlan` drives every backend:
+
+* **engines** (edges ``sim.py``, aligned ``aligned.py``, and all sharded
+  variants): the plan compiles to seed-deterministic per-round masks —
+  link-drop keeps (a counter-based integer hash of (peer, slot, round),
+  evaluated in-register inside the pallas kernels, mirroring the liveness
+  rewire hash), partition gates (group = ``peer_id % groups``), relay
+  defers, and scheduled crash/recovery updates to the alive mask.  All
+  draws are keyed on GLOBAL peer/edge ids, so faulted runs stay bitwise
+  invariant to the shard count and bitwise equal between the sharded and
+  unsharded aligned engines — the same determinism contract as churn.
+* **socket runtime** (``peer.py``): :func:`wrap_send` injects
+  drop/delay/duplication on the wire send path, and
+  ``transport.socket_transport.FaultyTransport`` refuses a fraction of
+  connects — exercising the retry-with-backoff send path.
+
+Fault model granularity (documented, asserted in tests/test_faults.py):
+
+* ``link_drop`` — each DIRECTED link transfer independently fails this
+  round.  Edges engine: per edge; aligned engine: per (receiver, slot)
+  via the in-kernel hash (exactly one hash per link per pass).
+* ``delay`` — a peer's relay of its frontier slips one round (the bits
+  stay in its frontier and are re-sent next round).  Sender-side,
+  per-peer granularity: the synchronous-round model has no per-link
+  flight buffer, and a deferred relay IS a one-round delivery delay for
+  every link it would have crossed.
+* ``duplicate`` — wire-level only (socket backend sends twice).  The
+  engines' OR-delivery is idempotent, so duplication cannot change
+  state there; its engine-side observable is the ``redeliveries``
+  metric (receipts of already-seen messages), emitted every round.
+* ``partitions`` — while a window is active, transfers between peers in
+  different groups (``peer_id % partition_groups``) are severed — push,
+  pull, and push-pull alike.  Liveness is NOT affected (a partitioned
+  peer is unreachable, not dead; the reference's ping would still cross
+  a real partition boundary only if routing allowed — modelling probe
+  loss is what ``link_drop`` composes with).  Groups must be a power of
+  two <= 128 so the aligned engine's lane arithmetic (``lane % g``)
+  equals the flat-id rule.
+* ``crash`` / ``recover`` — scheduled one-shot kills and revivals:
+  at round r a fraction of live peers dies / of dead peers returns.
+  These compose with (and complement) the continuous-hazard
+  ``ChurnConfig``; byzantine drop (suppression) and equivocation (junk
+  injection) remain the ``byzantine_fraction`` machinery, reachable
+  through the plan's ``byzantine`` field.
+
+This module deliberately imports nothing heavy at module scope —
+``config.py`` (stdlib-only by contract) imports it for key validation;
+jax enters only inside the mask helpers the engines call.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+#: int31 hash space: the kernels' keep hash is masked to [0, 2**31).
+_HASH_SPACE = 1 << 31
+
+
+def _parse_pairs(text: str, val_type, what: str):
+    """``"a:b+c:d"`` -> ((a, b), (c, d)) with ints on the left and
+    ``val_type`` on the right; raises ValueError with a readable message."""
+    out = []
+    for part in text.split("+"):
+        part = part.strip()
+        if not part:
+            continue
+        left, sep, right = part.partition(":")
+        if not sep:
+            raise ValueError(f"bad {what} entry {part!r} (want a:b)")
+        out.append((int(left), val_type(right)))
+    return tuple(out)
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """Declarative fault schedule — static (hashable) so the engines can
+    close over it in jitted round functions, exactly like ChurnConfig."""
+
+    link_drop: float = 0.0
+    delay: float = 0.0
+    duplicate: float = 0.0
+    partitions: tuple = ()          # ((start_round, heal_round), ...)
+    partition_groups: int = 2
+    crash: tuple = ()               # ((round, fraction_of_live), ...)
+    recover: tuple = ()             # ((round, fraction_of_dead), ...)
+    byzantine: float = 0.0          # merged into byzantine_fraction
+    seed: int = 0
+
+    # ------------------------------------------------------------------
+    def validate(self) -> "FaultPlan":
+        for name in ("link_drop", "delay", "duplicate", "byzantine"):
+            v = getattr(self, name)
+            if not 0.0 <= v < 1.0:
+                raise ValueError(f"fault {name} must be in [0, 1)")
+        g = self.partition_groups
+        if self.partitions:
+            if g < 2 or g > 128 or g & (g - 1):
+                raise ValueError(
+                    "fault partition_groups must be a power of two in "
+                    f"[2, 128] (got {g}) — the aligned engine's lane rule "
+                    "lane % g must equal peer_id % g")
+            for s, h in self.partitions:
+                if not 0 <= s < h:
+                    raise ValueError(
+                        f"fault partition window ({s}, {h}) needs "
+                        "0 <= start < heal")
+        for name in ("crash", "recover"):
+            for r, frac in getattr(self, name):
+                if r < 0 or not 0.0 <= frac <= 1.0:
+                    raise ValueError(
+                        f"fault {name} entry ({r}, {frac}) needs "
+                        "round >= 0 and fraction in [0, 1]")
+        return self
+
+    # -- what is active where ------------------------------------------
+    def engine_active(self) -> bool:
+        """Any fault the simulation engines must model."""
+        return bool(self.link_drop > 0.0 or self.delay > 0.0
+                    or self.partitions or self.crash or self.recover)
+
+    def kernel_active(self) -> bool:
+        """Faults that gate individual link transfers (the aligned
+        kernels' in-register hash path; the edges engine's edge gates)."""
+        return bool(self.link_drop > 0.0 or self.partitions)
+
+    def wire_active(self) -> bool:
+        """Any fault the socket wire wrapper must inject."""
+        return bool(self.link_drop > 0.0 or self.delay > 0.0
+                    or self.duplicate > 0.0)
+
+    # -- static compilations -------------------------------------------
+    def drop_threshold(self) -> int:
+        """int32 threshold in [0, 2**31): hash < threshold == dropped."""
+        return min(int(self.link_drop * _HASH_SPACE), _HASH_SPACE - 1)
+
+    def group_mask(self) -> int:
+        """``g - 1`` when partitioning is configured (group = id & mask),
+        else 0 (every peer in group 0 — partition gate trivially true)."""
+        return self.partition_groups - 1 if self.partitions else 0
+
+    def hash_seed(self) -> int:
+        return self.seed & 0x7FFFFFFF
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """Parse the CLI/bench spec grammar, e.g.
+        ``drop=0.2,delay=0.1,dup=0.05,partition=4:12+20:24,groups=2,``
+        ``crash=3:0.3,recover=16:0.5,byz=0.1,seed=7``."""
+        kw: dict = {}
+        for item in spec.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            key, sep, value = item.partition("=")
+            if not sep:
+                raise ValueError(f"bad fault spec item {item!r} "
+                                 "(want key=value)")
+            key = key.strip()
+            value = value.strip()
+            if key in ("drop", "link_drop"):
+                kw["link_drop"] = float(value)
+            elif key == "delay":
+                kw["delay"] = float(value)
+            elif key in ("dup", "duplicate"):
+                kw["duplicate"] = float(value)
+            elif key == "partition":
+                kw["partitions"] = _parse_pairs(value, int, "partition")
+            elif key in ("groups", "partition_groups"):
+                kw["partition_groups"] = int(value)
+            elif key == "crash":
+                kw["crash"] = _parse_pairs(value, float, "crash")
+            elif key == "recover":
+                kw["recover"] = _parse_pairs(value, float, "recover")
+            elif key in ("byz", "byzantine"):
+                kw["byzantine"] = float(value)
+            elif key == "seed":
+                kw["seed"] = int(value)
+            else:
+                raise ValueError(f"unknown fault spec key {key!r}")
+        return cls(**kw).validate()
+
+    def to_spec(self) -> str:
+        """Inverse of :meth:`parse` (for result lines / logs)."""
+        parts = []
+        if self.link_drop:
+            parts.append(f"drop={self.link_drop:g}")
+        if self.delay:
+            parts.append(f"delay={self.delay:g}")
+        if self.duplicate:
+            parts.append(f"dup={self.duplicate:g}")
+        if self.partitions:
+            parts.append("partition=" + "+".join(
+                f"{s}:{h}" for s, h in self.partitions))
+            parts.append(f"groups={self.partition_groups}")
+        if self.crash:
+            parts.append("crash=" + "+".join(
+                f"{r}:{f:g}" for r, f in self.crash))
+        if self.recover:
+            parts.append("recover=" + "+".join(
+                f"{r}:{f:g}" for r, f in self.recover))
+        if self.byzantine:
+            parts.append(f"byz={self.byzantine:g}")
+        if self.seed:
+            parts.append(f"seed={self.seed}")
+        return ",".join(parts)
+
+
+def plan_from_config(cfg) -> FaultPlan | None:
+    """Build the plan a parsed NetworkConfig describes via its
+    ``fault_*`` keys; None when no fault is configured (the engines then
+    compile exactly the code they always did — zero overhead)."""
+    plan = FaultPlan(
+        link_drop=cfg.fault_link_drop,
+        delay=cfg.fault_delay,
+        duplicate=cfg.fault_duplicate,
+        partitions=(_parse_pairs(cfg.fault_partition, int, "partition")
+                    if cfg.fault_partition else ()),
+        partition_groups=cfg.fault_partition_groups or 2,
+        crash=(_parse_pairs(cfg.fault_crash, float, "crash")
+               if cfg.fault_crash else ()),
+        recover=(_parse_pairs(cfg.fault_recover, float, "recover")
+                 if cfg.fault_recover else ()),
+        byzantine=cfg.fault_byzantine,
+        seed=cfg.fault_seed,
+    ).validate()
+    if not (plan.engine_active() or plan.wire_active()
+            or plan.byzantine > 0.0):
+        return None
+    return plan
+
+
+def apply_spec_to_config(cfg, spec: str) -> FaultPlan:
+    """CLI ``--fault-plan`` entry: parse ``spec`` and write it onto the
+    config's ``fault_*`` keys, so one resolution path (plan_from_config
+    inside each engine's from_config) serves flags and config files."""
+    plan = FaultPlan.parse(spec)
+    cfg.fault_link_drop = plan.link_drop
+    cfg.fault_delay = plan.delay
+    cfg.fault_duplicate = plan.duplicate
+    cfg.fault_partition = "+".join(f"{s}:{h}" for s, h in plan.partitions)
+    cfg.fault_partition_groups = plan.partition_groups
+    cfg.fault_crash = "+".join(f"{r}:{f:g}" for r, f in plan.crash)
+    cfg.fault_recover = "+".join(f"{r}:{f:g}" for r, f in plan.recover)
+    cfg.fault_byzantine = plan.byzantine
+    cfg.fault_seed = plan.seed
+    return plan
+
+
+# ----------------------------------------------------------------------
+# Engine-side mask builders.  jax imports live inside the functions so
+# config.py can import this module without pulling the array stack in.
+# Every draw is keyed on (plan.seed, round) + a fixed per-purpose tag —
+# NEVER on the simulation's own PRNG chain — so (a) an unfaulted run's
+# trajectory is untouched by the plan machinery existing at all, and
+# (b) the same plan produces the same fault pattern under any gossip
+# mode or engine family.
+
+#: per-purpose fold_in tags (one namespace for every engine, so the
+#: edges and aligned engines cannot accidentally correlate draws)
+TAG_EDGE_DROP = 11      # per-edge keep draw (edges engine)
+TAG_PULL_DROP = 13      # per-peer pull-contact keep draw (edges engine)
+TAG_DEFER = 7           # per-peer relay defer draw (both engines)
+TAG_CRASH = 101         # + entry index
+TAG_RECOVER = 211       # + entry index
+
+
+def round_key(plan: FaultPlan, round_idx):
+    """The per-round fault key: fold_in of the PLAN's seed (not the
+    simulation key chain) — deterministic in (plan.seed, round) alone."""
+    import jax
+
+    return jax.random.fold_in(
+        jax.random.PRNGKey(plan.hash_seed()), round_idx)
+
+
+def partition_active(plan: FaultPlan, round_idx):
+    """Traced int32 0/1: is any partition window active this round?"""
+    import jax.numpy as jnp
+
+    act = jnp.bool_(False)
+    for start, heal in plan.partitions:
+        act = act | ((round_idx >= start) & (round_idx < heal))
+    return act.astype(jnp.int32)
+
+
+def same_group(plan: FaultPlan, a, b, active):
+    """bool mask: may a transfer between peers ``a`` and ``b`` proceed
+    under the partition gate? (group = flat peer id & (g-1))."""
+    gmask = plan.group_mask()
+    return ((a & gmask) == (b & gmask)) | (active == 0)
+
+
+def schedule_step(plan: FaultPlan, fkey, alive, valid, round_idx,
+                  uniform_fn):
+    """Apply the crash/recover schedules to an alive mask.
+
+    ``uniform_fn(key) -> U(0,1) array shaped like alive`` is supplied by
+    the caller so each engine keeps its own shard-invariance discipline
+    (global-draw-and-slice for the edges engines, per-global-row fold_in
+    for the aligned family).  Static python loop: schedules are tuples,
+    so the compiled program contains exactly the configured entries."""
+    import jax
+
+    for i, (r, frac) in enumerate(plan.crash):
+        u = uniform_fn(jax.random.fold_in(fkey, TAG_CRASH + i))
+        alive = alive & ~((round_idx == r) & (u < frac))
+    for i, (r, frac) in enumerate(plan.recover):
+        u = uniform_fn(jax.random.fold_in(fkey, TAG_RECOVER + i))
+        alive = alive | ((round_idx == r) & (u < frac) & valid & ~alive)
+    return alive
+
+
+def kernel_meta(plan: FaultPlan, round_idx, pass_tag: int):
+    """int32[5] scalar-prefetch vector for the aligned kernels'
+    in-register fault gate: [round, hash seed, drop threshold,
+    group mask, partition active].  ``pass_tag`` decorrelates the push
+    and pull passes of one round (two passes = two independent uses of
+    the same links)."""
+    import jax.numpy as jnp
+
+    return jnp.stack([
+        jnp.int32(round_idx),
+        jnp.int32(plan.hash_seed() ^ (pass_tag * 0x632BE5AB & 0x7FFFFFFF)),
+        jnp.int32(plan.drop_threshold()),
+        jnp.int32(plan.group_mask()),
+        partition_active(plan, round_idx),
+    ])
+
+
+# ----------------------------------------------------------------------
+# Socket-side injection: real packet-level faults on the wire path.
+
+def wrap_send(send_fn, plan: FaultPlan, rng):
+    """Wrap a wire ``send(sock, payload)`` with the plan's link faults:
+
+    * drop — the payload is silently not sent (the TCP analogue of a
+      lost transfer; the caller believes it succeeded, exactly the
+      failure the anti-entropy/redelivery machinery must absorb);
+    * delay — the send is held for a short jitter (10-100 ms) first;
+    * duplicate — the payload is sent twice (receiver dedup absorbs it).
+
+    ``rng`` is the node's own random.Random, so a seeded PeerNode
+    produces a reproducible fault pattern."""
+    if plan is None or not plan.wire_active():
+        return send_fn
+
+    def faulty_send(sock, payload):
+        if plan.link_drop > 0.0 and rng.random() < plan.link_drop:
+            return                       # dropped on the (virtual) wire
+        if plan.delay > 0.0 and rng.random() < plan.delay:
+            import time
+
+            time.sleep(rng.uniform(0.01, 0.1))
+        send_fn(sock, payload)
+        if plan.duplicate > 0.0 and rng.random() < plan.duplicate:
+            send_fn(sock, payload)       # receiver dedup absorbs it
+
+    return faulty_send
+
+
+__all__ = [
+    "FaultPlan", "plan_from_config", "apply_spec_to_config",
+    "round_key", "partition_active", "same_group", "schedule_step",
+    "kernel_meta", "wrap_send",
+    "TAG_EDGE_DROP", "TAG_PULL_DROP", "TAG_DEFER",
+]

@@ -27,6 +27,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from p2p_gossipprotocol_tpu import faults as faults_lib
 from p2p_gossipprotocol_tpu.graph import Topology
 from p2p_gossipprotocol_tpu.ops.propagate import (
     sample_fanout_gate,
@@ -42,54 +43,139 @@ from p2p_gossipprotocol_tpu.transport.jax_transport import JaxTransport
 _DEFAULT_TRANSPORT = JaxTransport()
 
 
-def _advance(state: GossipState, recv: jax.Array, key: jax.Array
-             ) -> tuple[GossipState, jax.Array]:
-    """Fold received bits into the state; returns (state', deliveries)."""
+def _advance(state: GossipState, recv: jax.Array, key: jax.Array,
+             deferred: jax.Array | None = None
+             ) -> tuple[GossipState, jax.Array, jax.Array]:
+    """Fold received bits into the state; returns (state', deliveries,
+    redeliveries).  ``deferred`` (the fault plane's delayed relays) is
+    ORed back into the next frontier so a deferred transfer happens one
+    round late instead of never (flood-once would otherwise drop it)."""
     recv = recv & state.alive[:, None]
     new = recv & ~state.seen
     deliveries = jnp.sum(new, dtype=jnp.int32)
-    state = state.replace(seen=state.seen | new, frontier=new, key=key,
-                          round=state.round + 1)
-    return state, deliveries
+    redeliveries = jnp.sum(recv & state.seen, dtype=jnp.int32)
+    frontier = new if deferred is None else new | deferred
+    state = state.replace(seen=state.seen | new, frontier=frontier,
+                          key=key, round=state.round + 1)
+    return state, deliveries, redeliveries
+
+
+# -- fault-plane gating (faults.FaultPlan; None = the plain protocol) --
+
+def _link_gate(faults, fkey, topo: Topology, round_idx) -> jax.Array:
+    """bool[E_cap] keep gate: per-edge Bernoulli link drop AND the
+    partition gate (cross-group edges severed while a window is
+    active).  Drawn from the PLAN's key chain, never the simulation's,
+    so unfaulted trajectories are untouched by the plan existing."""
+    gate = None
+    if faults.link_drop > 0.0:
+        u = jax.random.uniform(
+            jax.random.fold_in(fkey, faults_lib.TAG_EDGE_DROP),
+            (topo.edge_capacity,))
+        gate = u >= faults.link_drop
+    if faults.partitions:
+        act = faults_lib.partition_active(faults, round_idx)
+        ok = faults_lib.same_group(faults, topo.src, topo.dst, act)
+        gate = ok if gate is None else (gate & ok)
+    return gate
+
+
+def _contact_gate(faults, fkey, state: GossipState, nbr: jax.Array
+                  ) -> jax.Array:
+    """bool[n] keep gate for the round's pull/push-pull contact: the
+    contact LINK drops with ``link_drop`` (one exchange = one link use)
+    and is severed across an active partition."""
+    n = state.n_peers
+    gate = None
+    if faults.link_drop > 0.0:
+        u = jax.random.uniform(
+            jax.random.fold_in(fkey, faults_lib.TAG_PULL_DROP), (n,))
+        gate = u >= faults.link_drop
+    if faults.partitions:
+        act = faults_lib.partition_active(faults, state.round)
+        me = jnp.arange(n, dtype=nbr.dtype)
+        ok = faults_lib.same_group(faults, me, nbr, act)
+        gate = ok if gate is None else (gate & ok)
+    return gate
+
+
+def _defer_split(faults, fkey, send: jax.Array
+                 ) -> tuple[jax.Array, jax.Array | None]:
+    """(send', deferred): with probability ``delay`` a peer's relay of
+    its frontier slips one round — the deferred bits leave this round's
+    send set and re-enter the frontier for the next."""
+    if faults.delay <= 0.0:
+        return send, None
+    n = send.shape[0]
+    u = jax.random.uniform(
+        jax.random.fold_in(fkey, faults_lib.TAG_DEFER), (n,))
+    hold = (u < faults.delay)[:, None]
+    return send & ~hold, send & hold
 
 
 def push_round(state: GossipState, topo: Topology, fanout: int = 0,
-               transport: Transport = _DEFAULT_TRANSPORT
-               ) -> tuple[GossipState, jax.Array]:
+               transport: Transport = _DEFAULT_TRANSPORT,
+               faults=None) -> tuple[GossipState, jax.Array, jax.Array]:
     """Flood push (fanout=0, the reference's broadcast) or bounded-fanout
     rumor mongering (fanout>0)."""
     key, k_fan = jax.random.split(state.key)
     send = state.frontier & state.alive[:, None] & ~state.byzantine[:, None]
     gate = sample_fanout_gate(k_fan, topo, fanout) if fanout > 0 else None
+    deferred = None
+    if faults is not None and faults.engine_active():
+        fkey = faults_lib.round_key(faults, state.round)
+        send, deferred = _defer_split(faults, fkey, send)
+        fgate = _link_gate(faults, fkey, topo, state.round)
+        if fgate is not None:
+            gate = fgate if gate is None else (gate & fgate)
     recv = transport.deliver(send, topo, gate)
-    return _advance(state, recv, key)
+    return _advance(state, recv, key, deferred)
 
 
 def pull_round(state: GossipState, topo: Topology,
-               transport: Transport = _DEFAULT_TRANSPORT
-               ) -> tuple[GossipState, jax.Array]:
+               transport: Transport = _DEFAULT_TRANSPORT,
+               faults=None) -> tuple[GossipState, jax.Array, jax.Array]:
     """Anti-entropy pull: every live peer contacts one random neighbor and
     copies its seen-set (the neighbor's full ``messageList``)."""
     key, k_nbr = jax.random.split(state.key)
     nbr, valid = sample_out_neighbor(k_nbr, topo)
     ok = (valid & state.alive & state.alive[nbr]
           & ~state.byzantine[nbr])          # byz peers refuse to serve pulls
+    if faults is not None and faults.engine_active():
+        fkey = faults_lib.round_key(faults, state.round)
+        cgate = _contact_gate(faults, fkey, state, nbr)
+        if cgate is not None:
+            ok = ok & cgate
     recv = transport.fetch(state.seen, nbr, ok)
     return _advance(state, recv, key)
 
 
 def pushpull_round(state: GossipState, topo: Topology, fanout: int = 0,
-                   transport: Transport = _DEFAULT_TRANSPORT
-                   ) -> tuple[GossipState, jax.Array]:
+                   transport: Transport = _DEFAULT_TRANSPORT,
+                   faults=None) -> tuple[GossipState, jax.Array, jax.Array]:
     """Push-pull: one contact per peer serves both directions (the classic
     anti-entropy exchange), plus the flood/fanout push of novel rumors."""
     key, k_fan, k_nbr = jax.random.split(state.key, 3)
     send = state.frontier & state.alive[:, None] & ~state.byzantine[:, None]
     gate = sample_fanout_gate(k_fan, topo, fanout) if fanout > 0 else None
+    deferred = None
+    faulted = faults is not None and faults.engine_active()
+    if faulted:
+        fkey = faults_lib.round_key(faults, state.round)
+        send, deferred = _defer_split(faults, fkey, send)
+        fgate = _link_gate(faults, fkey, topo, state.round)
+        if fgate is not None:
+            gate = fgate if gate is None else (gate & fgate)
     recv = transport.deliver(send, topo, gate)
 
     nbr, valid = sample_out_neighbor(k_nbr, topo)
     contact = valid & state.alive & state.alive[nbr]
+    if faulted:
+        # One exchange = one link use: drop/partition gates both
+        # directions of the contact together.
+        cgate = _contact_gate(faults, fkey, state, nbr)
+        if cgate is not None:
+            contact = contact & cgate
     # pull: i copies nbr(i)'s seen-set (unless nbr is byzantine)
     recv = recv | transport.fetch(state.seen, nbr,
                                   contact & ~state.byzantine[nbr])
@@ -97,20 +183,24 @@ def pushpull_round(state: GossipState, topo: Topology, fanout: int = 0,
     # byzantine) — scatter-OR over the sampled contacts.
     recv = transport.push_to(recv, state.seen, nbr,
                              contact & ~state.byzantine)
-    return _advance(state, recv, key)
+    return _advance(state, recv, key, deferred)
 
 
 def make_round_fn(mode: str, fanout: int = 0,
-                  transport: Transport | None = None):
+                  transport: Transport | None = None, faults=None):
     """Round function for a config ``mode`` (push | pull | pushpull),
-    signature ``(state, topo) -> (state', deliveries)``.  ``transport``
-    selects HOW bits move (default: the HBM OR-scatter) without touching
-    gossip semantics."""
+    signature ``(state, topo) -> (state', deliveries, redeliveries)``.
+    ``transport`` selects HOW bits move (default: the HBM OR-scatter)
+    without touching gossip semantics; ``faults`` (a
+    :class:`~p2p_gossipprotocol_tpu.faults.FaultPlan`) layers link
+    drop / delay / partition gates over whichever transport runs."""
     transport = _DEFAULT_TRANSPORT if transport is None else transport
     if mode == "push":
-        return partial(push_round, fanout=fanout, transport=transport)
+        return partial(push_round, fanout=fanout, transport=transport,
+                       faults=faults)
     if mode == "pull":
-        return partial(pull_round, transport=transport)
+        return partial(pull_round, transport=transport, faults=faults)
     if mode == "pushpull":
-        return partial(pushpull_round, fanout=fanout, transport=transport)
+        return partial(pushpull_round, fanout=fanout, transport=transport,
+                       faults=faults)
     raise ValueError(f"Unknown gossip mode: {mode}")

@@ -56,9 +56,16 @@ LANES = 128
 
 
 def _pass_kernel(pull: bool, n_planes: int, fanout: int, masked: bool,
-                 has_init: bool, finalize: bool, n_pref: int, *refs):
+                 has_init: bool, finalize: bool, faulty: bool,
+                 n_pref: int, *refs):
     pref, rest = refs[:n_pref], refs[n_pref:]
     subrolls_ref = pref[1]        # pref[0]=rolls, pref[2]=ytab (fused)
+    if faulty:
+        # Fault-plane scalar prefetch (faults.kernel_meta): gbase gives
+        # each block's first GLOBAL row id (the liveness pass's shard-
+        # invariance trick), fmeta = [round, seed, drop threshold, group
+        # mask, partition active].
+        gbase_ref, fmeta_ref = pref[-2], pref[-1]
     y_ref, col_ref, gate_ref = rest[0], rest[1], rest[2]
     i = 3
     if masked:
@@ -109,6 +116,26 @@ def _pass_kernel(pull: bool, n_planes: int, fanout: int, masked: bool,
         mask = (d < g) & (jnp.remainder(d - s, jnp.maximum(g, 1)) < fanout)
     else:
         mask = d < g
+    if faulty:
+        # Per-LINK fault gate, in-register (zero HBM traffic, shard-
+        # invariant — the same discipline as the liveness rewire hash):
+        # link (slot d of receiver p) drops iff hash(p, d, round, seed)
+        # lands under the drop threshold; while a partition window is
+        # active, transfers whose sender and receiver sit in different
+        # groups (group = peer_id % groups; for power-of-two groups
+        # <= 128 that equals lane % groups, and the sender's lane IS
+        # its colidx value) are severed.
+        t = pl.program_id(0)
+        flat = ((gbase_ref[t]
+                 + jax.lax.broadcasted_iota(jnp.int32, (blk, LANES), 0))
+                * LANES
+                + jax.lax.broadcasted_iota(jnp.int32, (blk, LANES), 1))
+        keep = (_fault_hash(flat, d, fmeta_ref[0], fmeta_ref[1])
+                >= fmeta_ref[2])
+        gmask = fmeta_ref[3]
+        lane = jax.lax.broadcasted_iota(jnp.int32, (blk, LANES), 1)
+        part_ok = ((lane & gmask) == (col & gmask)) | (fmeta_ref[4] == 0)
+        mask = mask & keep & part_ok
     if masked:
         okv = jnp.take_along_axis(
             pltpu.roll(ok_ref[:], blk - subrolls_ref[d], axis=0),
@@ -147,7 +174,10 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
                 src_ok: jax.Array | None = None,
                 acc_init: jax.Array | None = None,
                 seen: jax.Array | None = None,
-                rmask: jax.Array | None = None, rowblk: int = 512,
+                rmask: jax.Array | None = None,
+                fault_meta: jax.Array | None = None,
+                gbase: jax.Array | None = None,
+                rowblk: int = 512,
                 interpret: bool = False):
     """One OR-accumulated D-slot pass over W message planes.
 
@@ -189,6 +219,14 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
                 ``new = acc & rmask & ~seen`` and ``seen' = seen | new``
                 — replacing the XLA elementwise update (the traffic
                 model's seen|new term).
+    ``fault_meta``/``gbase`` — OPTIONAL link-fault gate
+                (faults.kernel_meta): ``fault_meta`` int32[5] = [round,
+                hash seed, drop threshold, partition group mask,
+                partition active], ``gbase`` int32[T] the global row id
+                of each output block's first row.  Each (receiver, slot)
+                link transfer is kept iff its integer hash clears the
+                threshold AND the partition gate passes — computed
+                in-register (no HBM mask tensor), shard-invariant.
     Returns int32[W, R, 128]: words each peer hears this pass — or the
     pair ``(new, seen')`` when ``seen`` is given.
     """
@@ -202,23 +240,32 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
     fanout = 0 if pull else fanout
     fused = ytab is not None
     finalize = seen is not None
+    faulty = fault_meta is not None
     if finalize:
         assert rmask is not None, "in-kernel seen-update needs rmask"
+    if faulty:
+        assert gbase is not None, "the fault gate needs gbase"
+        assert gbase.shape == (T,), (gbase.shape, T)
+    # Index maps take ``*_`` so the optional fault prefetch operands
+    # (gbase, fault_meta — appended below) never change their arity.
     if fused:
         assert src_ok is not None, "block-perm pass needs the src_ok mask"
         assert ytab.shape == (D, T), (ytab.shape, (D, T))
         n_pref = 3
         prefetch = (rolls, subrolls, ytab)
-        y_map = lambda t, d, k, s, yt: (0, yt[d, t], 0)
-        tab_map = lambda t, d, k, s, yt: (d, t, 0)
-        row_map = lambda t, d, k, s, yt: (t, 0)
-        ok_map = lambda t, d, k, s, yt: (yt[d, t], 0)
+        y_map = lambda t, d, k, s, yt, *_: (0, yt[d, t], 0)
+        tab_map = lambda t, d, k, s, yt, *_: (d, t, 0)
+        row_map = lambda t, d, k, s, yt, *_: (t, 0)
+        ok_map = lambda t, d, k, s, yt, *_: (yt[d, t], 0)
     else:
         n_pref = 2
         prefetch = (rolls, subrolls)
-        y_map = lambda t, d, k, s: (0, (t + k[d]) % Ty, 0)
-        tab_map = lambda t, d, k, s: (d, t, 0)
-        row_map = lambda t, d, k, s: (t, 0)
+        y_map = lambda t, d, k, s, *_: (0, (t + k[d]) % Ty, 0)
+        tab_map = lambda t, d, k, s, *_: (d, t, 0)
+        row_map = lambda t, d, k, s, *_: (t, 0)
+    if faulty:
+        prefetch = prefetch + (gbase, fault_meta)
+        n_pref += 2
     in_specs = [
         pl.BlockSpec((W, blk, C), y_map),
         pl.BlockSpec((1, blk, C), tab_map),
@@ -234,8 +281,7 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
         operands.append(shift)
     # d-constant index maps: these blocks load once per row block and
     # stay resident across the slot loop, exactly like the accumulator.
-    acc_map = ((lambda t, d, k, s, yt: (0, t, 0)) if fused
-               else (lambda t, d, k, s: (0, t, 0)))
+    acc_map = lambda t, d, *_: (0, t, 0)
     if acc_init is not None:
         in_specs.append(pl.BlockSpec((W, blk, C), acc_map))
         operands.append(acc_init)
@@ -260,7 +306,7 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
     )
     out = pl.pallas_call(
         functools.partial(_pass_kernel, pull, W, fanout, fused,
-                          acc_init is not None, finalize, n_pref),
+                          acc_init is not None, finalize, faulty, n_pref),
         grid_spec=grid_spec,
         out_shape=out_shape,
         interpret=interpret,
@@ -345,6 +391,32 @@ def _rewire_hash(flat_id, d, round_idx, seed):
     h = h ^ (d * jnp.int32(0x3243F6A9))
     h = h ^ (seed * jnp.int32(0x27220A95))
     return _mix32(h) & jnp.int32(LANES - 1)
+
+
+def _fault_hash(flat_id, d, round_idx, seed):
+    """31-bit keep hash for link (slot ``d`` of peer ``flat_id``) this
+    round — the fault plane's in-register Bernoulli draw (link dropped
+    iff hash < threshold).  Same splitmix finalizer as the rewire hash
+    but distinct xor constants, so rewire candidates and link drops at
+    the same (peer, slot, round) stay decorrelated.  Runs identically
+    inside the kernel and in :func:`fault_keep` (the jnp ground-truth /
+    parity path)."""
+    h = flat_id ^ (round_idx * jnp.int32(0x2545F491))
+    h = h ^ (d * jnp.int32(0x19660D1F))
+    h = h ^ (seed * jnp.int32(0x7FEB352D))
+    return _mix32(h) & jnp.int32(0x7FFFFFFF)
+
+
+def fault_keep(grows: jax.Array, n_slots: int, round_idx, seed,
+               threshold) -> jax.Array:
+    """jnp reference of the in-kernel link-drop gate: bool[D, R, 128]
+    keep mask for global rows ``grows`` — what the kernel computes on
+    the fly, materialized (tests / the exact-engine bridge)."""
+    flat = (grows.astype(jnp.int32)[None, :, None] * LANES
+            + jnp.arange(LANES, dtype=jnp.int32)[None, None, :])
+    d = jnp.arange(n_slots, dtype=jnp.int32)[:, None, None]
+    return _fault_hash(flat, d, jnp.int32(round_idx),
+                       jnp.int32(seed)) >= jnp.int32(threshold)
 
 
 def rewire_candidates(grows: jax.Array, n_slots: int, round_idx,

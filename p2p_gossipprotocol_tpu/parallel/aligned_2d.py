@@ -41,7 +41,8 @@ from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator, AlignedState,
                                             AlignedTopology, aligned_round)
 from p2p_gossipprotocol_tpu.liveness import ChurnConfig
 from p2p_gossipprotocol_tpu.parallel.aligned_sharded import _topo_spec
-from p2p_gossipprotocol_tpu.parallel.mesh import PEER_AXIS
+from p2p_gossipprotocol_tpu.parallel.mesh import (PEER_AXIS,
+                                                   shard_map_compat)
 
 MSG_AXIS = "msgs"
 
@@ -91,6 +92,10 @@ class Aligned2DShardedSimulator:
     message_stagger: int = 0
     fuse_update: bool = False
     pull_window: bool = False
+    #: faults.FaultPlan — fault masks are per-peer / per-link (message-
+    #: plane-independent), so every msg shard computes bit-identical
+    #: gates and the 2-D engine inherits the parity contract unchanged.
+    faults: object | None = None
     seed: int = 0
     interpret: bool | None = None
 
@@ -108,7 +113,8 @@ class Aligned2DShardedSimulator:
             liveness_every=self.liveness_every,
             message_stagger=self.message_stagger,
             fuse_update=self.fuse_update,
-            pull_window=self.pull_window, seed=self.seed,
+            pull_window=self.pull_window, faults=self.faults,
+            seed=self.seed,
             interpret=self.interpret)
         self.churn = self._inner.churn
         self.interpret = self._inner.interpret
@@ -185,7 +191,7 @@ class Aligned2DShardedSimulator:
             tp_spec = _topo_spec(self.topo)
             metric_spec = {k: P() for k in ("coverage", "deliveries",
                                             "frontier_size", "live_peers",
-                                            "evictions")}
+                                            "evictions", "redeliveries")}
 
             def scanned(st, tp):
                 def body(carry, _):
@@ -194,11 +200,10 @@ class Aligned2DShardedSimulator:
                     return (s, t), metrics
                 return jax.lax.scan(body, (st, tp), None, length=rounds)
 
-            self._run_cache[rounds] = jax.jit(jax.shard_map(
+            self._run_cache[rounds] = jax.jit(shard_map_compat(
                 scanned, mesh=self.mesh,
                 in_specs=(st_spec, tp_spec),
-                out_specs=((st_spec, tp_spec), metric_spec),
-                check_vma=False))
+                out_specs=((st_spec, tp_spec), metric_spec)))
         fn = self._run_cache[rounds]
         if warmup:
             (w_state, _), _ = fn(state, topo)
@@ -238,11 +243,10 @@ class Aligned2DShardedSimulator:
                 self._step_local, target=target, max_rounds=max_rounds,
                 check_every=check_every, sched_end=sched_end)
 
-            fn = jax.jit(jax.shard_map(
+            fn = jax.jit(shard_map_compat(
                 looped, mesh=self.mesh,
                 in_specs=(st_spec, tp_spec),
-                out_specs=(st_spec, tp_spec, P()),
-                check_vma=False))
+                out_specs=(st_spec, tp_spec, P())))
             self._run_cache[cache_key] = fn.lower(state, topo).compile()
         fn_c = self._run_cache[cache_key]
         if warmup:

@@ -44,7 +44,8 @@ from p2p_gossipprotocol_tpu.aligned_sir import (AlignedSIRSimulator,
                                                 AlignedSIRState,
                                                 aligned_sir_round)
 from p2p_gossipprotocol_tpu.liveness import ChurnConfig
-from p2p_gossipprotocol_tpu.parallel.mesh import PEER_AXIS, make_mesh
+from p2p_gossipprotocol_tpu.parallel.mesh import (PEER_AXIS, make_mesh,
+                                                   shard_map_compat)
 
 AXIS = PEER_AXIS
 
@@ -88,6 +89,10 @@ class AlignedShardedSimulator:
     message_stagger: int = 0
     fuse_update: bool = False
     pull_window: bool = False
+    #: faults.FaultPlan — the round implementation (aligned_round) draws
+    #: every fault mask per GLOBAL row / in-kernel global-id hash, so a
+    #: faulted sharded run stays bitwise-equal to the unsharded engine.
+    faults: object | None = None
     seed: int = 0
     interpret: bool | None = None
 
@@ -112,6 +117,7 @@ class AlignedShardedSimulator:
             message_stagger=self.message_stagger,
             fuse_update=self.fuse_update,
             pull_window=self.pull_window,
+            faults=self.faults,
             seed=self.seed, interpret=self.interpret)
         self.churn = self._inner.churn
         self.interpret = self._inner.interpret
@@ -166,7 +172,7 @@ class AlignedShardedSimulator:
         tp = _topo_spec(self.topo)
         metric = {k: P() for k in ("coverage", "deliveries",
                                    "frontier_size", "live_peers",
-                                   "evictions")}
+                                   "evictions", "redeliveries")}
         return st, tp, metric
 
     def run(self, rounds: int, state: AlignedState | None = None,
@@ -194,11 +200,10 @@ class AlignedShardedSimulator:
                     return (s, t), metrics
                 return jax.lax.scan(body, (st, tp), None, length=rounds)
 
-            self._run_cache[rounds] = jax.jit(jax.shard_map(
+            self._run_cache[rounds] = jax.jit(shard_map_compat(
                 scanned, mesh=self.mesh,
                 in_specs=(st_spec, tp_spec),
-                out_specs=((st_spec, tp_spec), metric_spec),
-                check_vma=False))
+                out_specs=((st_spec, tp_spec), metric_spec)))
         fn = self._run_cache[rounds]
         if warmup:
             (w_state, _), _ = fn(state, topo)
@@ -241,11 +246,10 @@ class AlignedShardedSimulator:
                 self._step_local, target=target, max_rounds=max_rounds,
                 check_every=check_every, sched_end=sched_end)
 
-            fn = jax.jit(jax.shard_map(
+            fn = jax.jit(shard_map_compat(
                 looped, mesh=self.mesh,
                 in_specs=(st_spec, tp_spec),
-                out_specs=(st_spec, tp_spec, P()),
-                check_vma=False))
+                out_specs=(st_spec, tp_spec, P())))
             self._loop_cache[cache_key] = fn.lower(state, topo).compile()
         fn_c = self._loop_cache[cache_key]
         if warmup:
@@ -356,11 +360,10 @@ class AlignedShardedSIRSimulator:
                     return s, metrics
                 return jax.lax.scan(body, st, None, length=rounds)
 
-            self._scan_cache[rounds] = jax.jit(jax.shard_map(
+            self._scan_cache[rounds] = jax.jit(shard_map_compat(
                 scanned, mesh=self.mesh,
                 in_specs=(st_spec, tp_spec),
-                out_specs=(st_spec, metric_spec),
-                check_vma=False))
+                out_specs=(st_spec, metric_spec)))
         if warmup:
             w_state, _ = self._scan_cache[rounds](state, topo)
             int(jax.device_get(w_state.round))

@@ -17,6 +17,26 @@ from jax.sharding import Mesh
 PEER_AXIS = "peers"
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across the jax versions this repo meets in the
+    wild: new jax exposes it at the top level (replication checking via
+    ``check_vma``), older releases (<= 0.4.x) under
+    ``jax.experimental.shard_map`` with the ``check_rep`` spelling.
+    Replication checking is disabled either way — the engines' metric
+    replication is by deterministic construction (per-global-row draws),
+    which the checker cannot see through.  Without this shim every
+    sharded engine (and its tier-1 suite) dies on AttributeError on an
+    0.4.x install, single-handedly the largest failure class in the
+    seed baseline."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
 def make_mesh(n_devices: int | None = None,
               devices: list | None = None) -> Mesh:
     """A 1-D mesh over ``n_devices`` (default: all available devices).

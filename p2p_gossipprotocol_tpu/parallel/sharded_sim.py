@@ -29,10 +29,12 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from p2p_gossipprotocol_tpu import faults as faults_lib
 from p2p_gossipprotocol_tpu.graph import Topology
 from p2p_gossipprotocol_tpu.liveness import ChurnConfig
 from p2p_gossipprotocol_tpu.models.byzantine import inject_byzantine
-from p2p_gossipprotocol_tpu.parallel.mesh import PEER_AXIS, make_mesh
+from p2p_gossipprotocol_tpu.parallel.mesh import (PEER_AXIS, make_mesh,
+                                                   shard_map_compat)
 from p2p_gossipprotocol_tpu.parallel.partition import (
     ShardedTopology,
     partition_topology,
@@ -90,6 +92,11 @@ class ShardedSimulator:
     #: staggered generation (sim.Simulator.message_stagger): column m
     #: enters at its source in round m*k; 0 = all rumors at round 0.
     message_stagger: int = 0
+    #: faults.FaultPlan — link drop / delay / partition / crash-recovery
+    #: schedules.  Every fault draw is global-then-sliced (the same
+    #: shard-invariance discipline as churn/rewire), so faulted runs
+    #: stay bitwise-invariant to the shard count.
+    faults: object | None = None
     seed: int = 0
 
     def __post_init__(self):
@@ -97,6 +104,8 @@ class ShardedSimulator:
             self.mesh = make_mesh()
         if self.mode not in ("push", "pull", "pushpull"):
             raise ValueError(f"Unknown gossip mode: {self.mode}")
+        if self.faults is not None:
+            self.faults.validate()
         self.n_shards = int(np.prod(self.mesh.devices.shape))
         self.stopo = partition_topology(self.topo, self.n_shards)
         self._n_honest = (self.n_honest_msgs
@@ -158,17 +167,25 @@ class ShardedSimulator:
     # ------------------------------------------------------------------
     def _churn_local(self, key, alive, round_idx, valid_peer, topo, lo):
         cfg = self.churn
-        if cfg.rate <= 0.0 and cfg.revive <= 0.0:
-            return alive
-        k_die, k_rev = jax.random.split(key)
-        u_die = _peer_uniform(k_die, topo.n_pad, lo, topo.block)
-        if cfg.kill_round >= 0:
-            dies = (round_idx == cfg.kill_round) & (u_die < cfg.rate)
-        else:
-            dies = u_die < cfg.rate
-        u_rev = _peer_uniform(k_rev, topo.n_pad, lo, topo.block)
-        revives = u_rev < cfg.revive
-        return ((alive & ~dies) | (~alive & revives)) & valid_peer
+        if cfg.rate > 0.0 or cfg.revive > 0.0:
+            k_die, k_rev = jax.random.split(key)
+            u_die = _peer_uniform(k_die, topo.n_pad, lo, topo.block)
+            if cfg.kill_round >= 0:
+                dies = (round_idx == cfg.kill_round) & (u_die < cfg.rate)
+            else:
+                dies = u_die < cfg.rate
+            u_rev = _peer_uniform(k_rev, topo.n_pad, lo, topo.block)
+            revives = u_rev < cfg.revive
+            alive = ((alive & ~dies) | (~alive & revives)) & valid_peer
+        plan = self.faults
+        if plan is not None and (plan.crash or plan.recover):
+            # Scheduled crash/recovery (sim.Simulator.step's rule) with
+            # the shard-invariant global-draw-and-slice idiom.
+            alive = faults_lib.schedule_step(
+                plan, faults_lib.round_key(plan, round_idx),
+                alive, valid_peer, round_idx,
+                lambda k: _peer_uniform(k, topo.n_pad, lo, topo.block))
+        return alive
 
     def _strike_local(self, key, topo: ShardedTopology, strikes, alive_g):
         """Per-edge 3-strike liveness + rewiring, as in
@@ -209,16 +226,36 @@ class ShardedSimulator:
 
     def _gossip_local(self, key, state: GossipState, topo: ShardedTopology,
                       alive_g, byz_g, lo):
-        """One dissemination round; returns (state', deliveries)."""
+        """One dissemination round; returns (state', deliveries,
+        redeliveries)."""
         k_fan, k_nbr = jax.random.split(key)
         m = state.n_msgs
         partial = jnp.zeros((topo.n_pad, m), bool)
         do_push = self.mode in ("push", "pushpull")
         do_pull = self.mode in ("pull", "pushpull")
 
+        # Fault-plane gates (models/gossip.py semantics, global-draw
+        # idioms): drawn from the PLAN's key chain, never the round key,
+        # so unfaulted trajectories are untouched.
+        plan = self.faults
+        faulted = plan is not None and plan.engine_active()
+        deferred = None
+        part_act = None
+        if faulted:
+            fkey = faults_lib.round_key(plan, state.round)
+            if plan.partitions:
+                part_act = faults_lib.partition_active(plan, state.round)
+
         if do_push:
             send = (state.frontier & state.alive[:, None]
                     & ~state.byzantine[:, None])
+            if faulted and plan.delay > 0.0:
+                u = _peer_uniform(
+                    jax.random.fold_in(fkey, faults_lib.TAG_DEFER),
+                    topo.n_pad, lo, topo.block)
+                hold = (u < plan.delay)[:, None]
+                deferred = send & hold
+                send = send & ~hold
             gate = topo.edge_mask
             if self.fanout > 0:
                 deg = (topo.row_ptr[1:] - topo.row_ptr[:-1]
@@ -226,6 +263,14 @@ class ShardedSimulator:
                 rate = jnp.minimum(1.0, self.fanout / jnp.maximum(deg, 1.0))
                 u = _edge_uniform(k_fan, topo.e_gcap, topo.gidx)
                 gate = gate & (u < rate[topo.src - lo])
+            if faulted and plan.link_drop > 0.0:
+                u = _edge_uniform(
+                    jax.random.fold_in(fkey, faults_lib.TAG_EDGE_DROP),
+                    topo.e_gcap, topo.gidx)
+                gate = gate & (u >= plan.link_drop)
+            if part_act is not None:
+                gate = gate & faults_lib.same_group(
+                    plan, topo.src, topo.dst, part_act)
             vals = send[topo.src - lo] & gate[:, None]
             partial = partial.at[topo.dst].max(vals, mode="drop")
 
@@ -240,6 +285,19 @@ class ShardedSimulator:
                 jnp.packbits(state.seen, axis=-1), AXIS, tiled=True)
             nbr, valid = self._sample_neighbor_local(k_nbr, topo, lo)
             contact = valid & state.alive & alive_g[nbr]
+            if faulted:
+                # One exchange = one link use (models/gossip.py rule):
+                # the contact link drops with link_drop and is severed
+                # across an active partition, both directions at once.
+                if plan.link_drop > 0.0:
+                    u = _peer_uniform(
+                        jax.random.fold_in(fkey, faults_lib.TAG_PULL_DROP),
+                        topo.n_pad, lo, topo.block)
+                    contact = contact & (u >= plan.link_drop)
+                if part_act is not None:
+                    gid = lo + jnp.arange(topo.block, dtype=nbr.dtype)
+                    contact = contact & faults_lib.same_group(
+                        plan, gid, nbr, part_act)
             nbr_seen = jnp.unpackbits(packed_g[nbr], axis=-1,
                                       count=m).astype(bool)
             recv_pull = nbr_seen & (contact & ~byz_g[nbr])[:, None]
@@ -259,9 +317,12 @@ class ShardedSimulator:
         recv = recv & state.alive[:, None]
         new = recv & ~state.seen
         deliveries = jax.lax.psum(jnp.sum(new, dtype=jnp.int32), AXIS)
-        state = state.replace(seen=state.seen | new, frontier=new,
+        redeliveries = jax.lax.psum(
+            jnp.sum(recv & state.seen, dtype=jnp.int32), AXIS)
+        frontier = new if deferred is None else new | deferred
+        state = state.replace(seen=state.seen | new, frontier=frontier,
                               round=state.round + 1)
-        return state, deliveries
+        return state, deliveries, redeliveries
 
     # ------------------------------------------------------------------
     def _step_local(self, state: GossipState, topo: ShardedTopology):
@@ -307,7 +368,7 @@ class ShardedSimulator:
 
         byz_g = (jax.lax.all_gather(state.byzantine, AXIS, tiled=True)
                  if self.mode in ("pull", "pushpull") else None)
-        state, deliveries = self._gossip_local(
+        state, deliveries, redeliveries = self._gossip_local(
             k_round, state, topo, alive_g, byz_g, lo)
 
         ok = state.alive & ~state.byzantine
@@ -335,6 +396,7 @@ class ShardedSimulator:
             "live_peers": jax.lax.psum(
                 jnp.sum(state.alive, dtype=jnp.int32), AXIS),
             "evictions": n_evict,
+            "redeliveries": redeliveries,
         }
         return state, topo, metrics
 
@@ -345,7 +407,7 @@ class ShardedSimulator:
         from jax.sharding import PartitionSpec as P
         metric_spec = {k: P() for k in ("coverage", "deliveries",
                                         "frontier_size", "live_peers",
-                                        "evictions")}
+                                        "evictions", "redeliveries")}
         return st_spec, tp_spec, metric_spec
 
     def run(self, rounds: int, state: GossipState | None = None,
@@ -372,11 +434,10 @@ class ShardedSimulator:
                     return (st, tp), metrics
                 return jax.lax.scan(body, (st, tp), None, length=rounds)
 
-            self._run_cache[rounds] = jax.jit(jax.shard_map(
+            self._run_cache[rounds] = jax.jit(shard_map_compat(
                 scanned, mesh=self.mesh,
                 in_specs=(st_spec, tp_spec),
-                out_specs=((st_spec, tp_spec), metric_spec),
-                check_vma=False))
+                out_specs=((st_spec, tp_spec), metric_spec)))
         fn = self._run_cache[rounds]
 
         t0 = _time.perf_counter()
@@ -414,11 +475,10 @@ class ShardedSimulator:
                 self._step_local, target=target, max_rounds=max_rounds,
                 check_every=check_every, sched_end=sched_end)
 
-            fn = jax.jit(jax.shard_map(
+            fn = jax.jit(shard_map_compat(
                 looped, mesh=self.mesh,
                 in_specs=(st_spec, tp_spec),
-                out_specs=(st_spec, tp_spec, P()),
-                check_vma=False))
+                out_specs=(st_spec, tp_spec, P())))
             self._loop_cache[cache_key] = fn.lower(state, stopo).compile()
         fn_c = self._loop_cache[cache_key]
         if warmup:

@@ -99,7 +99,8 @@ class PeerNode:
                  rng: random.Random | None = None,
                  wire_format: str = "json",
                  generation_delay_s: float = 0.0,
-                 anti_entropy_interval: float = 0.0):
+                 anti_entropy_interval: float = 0.0,
+                 fault_plan=None):
         self.ip = ip
         self.port = port
         self.seeds = seeds
@@ -127,7 +128,23 @@ class PeerNode:
         # length-prefixed robust mode (SURVEY.md §2-C7)
         self._send, self._stream_cls = WIRE_FORMATS[wire_format]
 
-        self.transport = SocketTransport(ip, port)
+        # Fault plane (faults.FaultPlan): the same plan the engines
+        # consume, mirrored at the wire — document sends drop/delay/
+        # duplicate (wrap_send) and outbound connects get refused
+        # (FaultyTransport) with the plan's probabilities.  The node's
+        # own rng drives both, so a seeded node faults reproducibly.
+        self.fault_plan = fault_plan
+        if fault_plan is not None and fault_plan.wire_active():
+            from p2p_gossipprotocol_tpu import faults as _faults
+            from p2p_gossipprotocol_tpu.transport.socket_transport import \
+                FaultyTransport
+
+            self._send = _faults.wrap_send(self._send, fault_plan,
+                                           self.rng)
+            self.transport = FaultyTransport(ip, port, plan=fault_plan,
+                                             rng=self.rng)
+        else:
+            self.transport = SocketTransport(ip, port)
         self.running = False
         # (ip, port) -> outbound socket   (reference connectedPeers)
         self.connected_peers: dict[tuple[str, int], object] = {}
@@ -151,12 +168,75 @@ class PeerNode:
         self._threads: list[threading.Thread] = []
         self.log = NodeLogger("peer", port, log_dir)
 
+    #: resilient send path: bounded retries with exponential backoff.
+    #: Worst case per dead peer ~0.35 s (0.05 + 0.1 + 0.2) — long enough
+    #: to ride out a refused connect or a dropped socket, short enough
+    #: that a relay thread never wedges behind an unreachable peer (the
+    #: liveness sweep owns longer outages).
+    SEND_RETRIES = 3
+    SEND_BACKOFF_S = 0.05
+
     def _locked_send(self, sock, payload: dict) -> None:
         """Serialize writers per socket (see _send_locks)."""
         with self._send_locks_guard:
             lock = self._send_locks.setdefault(sock, threading.Lock())
         with lock:
             self._send(sock, payload)
+
+    def _send_resilient(self, key, sock, payload: dict) -> bool:
+        """Send to a connected peer with retry + reconnect-with-backoff.
+
+        The old path silently lost the message on the FIRST send/connect
+        failure: ``_broadcast`` rolled the peer out of ``sent_to`` but
+        nothing ever re-sent, so one refused connect or RST during a
+        blip dropped the rumor for that link forever (flood-once never
+        retries).  Here a failed send backs off, reconnects to the
+        peer's listen port, and retries — bounded (SEND_RETRIES), so a
+        genuinely dead peer still falls through to the liveness sweep.
+        Returns True once the payload was handed to a socket."""
+        delay = self.SEND_BACKOFF_S
+        for attempt in range(self.SEND_RETRIES + 1):
+            if sock is not None:
+                try:
+                    self._locked_send(sock, payload)
+                    return True
+                except _SEND_ERRORS():
+                    pass
+            if attempt >= self.SEND_RETRIES or not self.running:
+                return False
+            if not self._sleep_while_running(delay):
+                return False
+            delay *= 2
+            fresh = self.transport.connect_to(*key)
+            if fresh is None:
+                continue              # unreachable this attempt
+            fresh.settimeout(None)    # see _select_and_connect_peers
+            with self.peers_lock:
+                cur = self.connected_peers.get(key)
+                replace = cur is None or cur is sock
+                if replace:
+                    self.connected_peers[key] = fresh
+            if replace:
+                if sock is not None:
+                    self._drop_send_lock(sock)
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                t = threading.Thread(target=self._handle_client,
+                                     args=(fresh, key), daemon=True)
+                t.start()
+                self._track(t)
+                sock = fresh
+            else:
+                # another thread already re-established the link — use
+                # its socket, discard ours
+                try:
+                    fresh.close()
+                except OSError:
+                    pass
+                sock = cur
+        return False
 
     def _drop_send_lock(self, sock) -> None:
         with self._send_locks_guard:
@@ -276,7 +356,7 @@ class PeerNode:
                 return
 
     def _connect_to_seed(self, seed: PeerInfo) -> bool:
-        sock = SocketTransport.connect(seed.ip, seed.port)
+        sock = self.transport.connect_to(seed.ip, seed.port)
         if sock is None:
             return False
         try:
@@ -326,7 +406,7 @@ class PeerNode:
             with self.peers_lock:
                 if key in self.connected_peers:
                     continue
-            sock = SocketTransport.connect(peer.ip, peer.port)
+            sock = self.transport.connect_to(peer.ip, peer.port)
             if sock is None:
                 continue
             # The connect timeout must not outlive the handshake: left in
@@ -519,9 +599,7 @@ class PeerNode:
                 tracker.sent_to.update(k for k, _ in targets)
         failed = []
         for key, sock in targets:
-            try:
-                self._locked_send(sock, payload)
-            except _SEND_ERRORS():
+            if not self._send_resilient(key, sock, payload):
                 failed.append(key)
         if failed:
             with self.message_lock:
@@ -555,7 +633,7 @@ class PeerNode:
     def _probe(self, ip: str, port: int) -> bool:
         """TCP-connect probe of the peer's listen port — detects a dead
         PROCESS, which the reference's ICMP host ping cannot."""
-        sock = SocketTransport.connect(ip, port, timeout=1.0)
+        sock = self.transport.connect_to(ip, port, timeout=1.0)
         if sock is None:
             return False
         try:
@@ -636,7 +714,7 @@ class PeerNode:
         for seed in self.seeds:
             if seed.ip == ip and seed.port == port:
                 continue
-            s = SocketTransport.connect(seed.ip, seed.port)
+            s = self.transport.connect_to(seed.ip, seed.port)
             if s is None:
                 continue
             try:

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from p2p_gossipprotocol_tpu import faults as faults_lib
 from p2p_gossipprotocol_tpu import graph as graph_lib
 from p2p_gossipprotocol_tpu.graph import Topology
 from p2p_gossipprotocol_tpu.liveness import (ChurnConfig, churn_step,
@@ -85,7 +86,10 @@ class SimResult(_FromMetrics):
     frontier_size: np.ndarray  #   the aligned engines — exact popcount
     live_peers: np.ndarray     #   pairs combine to float so totals past
     evictions: np.ndarray      #   2^31 bits don't wrap (aligned.py)
-    wall_s: float = 0.0
+    redeliveries: np.ndarray = None  # receipts of already-seen messages
+    wall_s: float = 0.0        #   (the degradation metric link faults
+    #                              inflate; 0 under aligned fuse_update,
+    #                              whose kernel never materializes recv)
 
     def rounds_to(self, target: float = 0.99) -> int:
         """First 1-indexed round reaching target coverage, or -1."""
@@ -123,10 +127,17 @@ class Simulator:
     message_stagger: int = 0
     seed: int = 0
     transport: object | None = None   # Transport; None → JaxTransport
+    #: declarative fault plan (faults.FaultPlan): link drop, relay delay,
+    #: partition windows, crash/recovery schedules.  None = the plain
+    #: protocol, compiled exactly as before the fault plane existed.
+    faults: object | None = None
 
     def __post_init__(self):
+        if self.faults is not None:
+            self.faults.validate()
         self._round_fn = make_round_fn(self.mode, self.fanout,
-                                       transport=self.transport)
+                                       transport=self.transport,
+                                       faults=self.faults)
         self._n_honest = (self.n_honest_msgs
                           if self.n_honest_msgs is not None else self.n_msgs)
 
@@ -195,6 +206,17 @@ class Simulator:
         key, k_churn, k_rewire = jax.random.split(state.key, 3)
         state = state.replace(key=key)
         alive = churn_step(k_churn, state.alive, state.round, self.churn)
+        if self.faults is not None and (self.faults.crash
+                                        or self.faults.recover):
+            # Scheduled crash/recovery (the fault plane's one-shot
+            # complement to the continuous churn hazard).  Crashes are
+            # real deaths — the liveness strikes below observe them,
+            # unlike partitions, which sever transfers only.
+            n = alive.shape[0]
+            alive = faults_lib.schedule_step(
+                self.faults, faults_lib.round_key(self.faults, state.round),
+                alive, jnp.ones(n, bool), state.round,
+                lambda k: jax.random.uniform(k, (n,)))
         state = state.replace(alive=alive)
         topo, strikes, n_evict = strike_and_rewire(
             k_rewire, topo, state.edge_strikes, alive,
@@ -204,7 +226,7 @@ class Simulator:
             state = inject_byzantine(state, self._n_honest)
         if self.message_stagger > 0:
             state = self._generate_messages(state)
-        state, deliveries = self._round_fn(state, topo)
+        state, deliveries, redeliveries = self._round_fn(state, topo)
         metrics = {
             "coverage": coverage_of(state, self._n_honest,
                                     stagger=self.message_stagger),
@@ -212,6 +234,7 @@ class Simulator:
             "frontier_size": jnp.sum(state.frontier, dtype=jnp.int32),
             "live_peers": jnp.sum(state.alive, dtype=jnp.int32),
             "evictions": n_evict,
+            "redeliveries": redeliveries,
         }
         return state, topo, metrics
 
@@ -287,8 +310,15 @@ class Simulator:
         """Build simulator + overlay from a :class:`NetworkConfig`."""
         topo = graph_lib.from_config(cfg, n_peers=n_peers)
         n_msgs = cfg.n_messages or cfg.max_message_count
+        plan = faults_lib.plan_from_config(cfg)
+        # The plan's byzantine knob is the unified entry to the existing
+        # adversary machinery (drop = suppression, equivocation = junk
+        # injection) — merged, never silently overriding an explicit
+        # byzantine_fraction.
+        byz = max(cfg.byzantine_fraction,
+                  plan.byzantine if plan else 0.0)
         n_junk = 0
-        if cfg.byzantine_fraction > 0.0:
+        if byz > 0.0:
             n_junk = max(1, n_msgs // 4)
         churn = ChurnConfig(rate=cfg.churn_rate) if cfg.churn_rate else \
             ChurnConfig()
@@ -298,11 +328,12 @@ class Simulator:
             mode=cfg.mode,
             fanout=cfg.fanout,
             churn=churn,
-            byzantine_fraction=cfg.byzantine_fraction,
+            byzantine_fraction=byz,
             n_honest_msgs=n_msgs if n_junk else None,
             max_strikes=cfg.max_missed_pings,
             message_stagger=cfg.message_stagger,
             seed=cfg.prng_seed,
+            faults=plan if plan and plan.engine_active() else None,
         )
 
 
@@ -409,6 +440,12 @@ class SIRSimulator:
     # ------------------------------------------------------------------
     @classmethod
     def from_config(cls, cfg, n_peers: int | None = None) -> "SIRSimulator":
+        plan = faults_lib.plan_from_config(cfg)
+        if plan is not None and plan.engine_active():
+            raise ValueError(
+                "fault plans apply to the gossip modes — the SIR model "
+                "has no message-transfer path to fault (use churn_rate "
+                "for its peer-level failures)")
         topo = graph_lib.from_config(cfg, n_peers=n_peers)
         return cls(
             topo=topo,

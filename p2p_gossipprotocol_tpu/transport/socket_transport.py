@@ -183,6 +183,13 @@ class SocketTransport:
         except OSError:
             return None
 
+    def connect_to(self, ip: str, port: int, timeout: float = 2.0
+                   ) -> socket.socket | None:
+        """Instance-level connect — the seam :class:`FaultyTransport`
+        overrides to inject link faults; the base class just delegates
+        to the static :meth:`connect`."""
+        return self.connect(ip, port, timeout)
+
     def stop(self) -> None:
         if self.listener is not None:
             try:
@@ -190,3 +197,39 @@ class SocketTransport:
             except OSError:
                 pass
             self.listener = None
+
+
+class FaultyTransport(SocketTransport):
+    """Fault-injecting :class:`SocketTransport` — the socket-backend
+    mirror of the engines' fault plane (faults.FaultPlan):
+
+    * ``link_drop`` — an outbound connect is refused with this
+      probability (the caller sees the same ``None`` a refused TCP
+      connect yields, so the retry/backoff machinery — not special
+      cases — absorbs it);
+    * ``delay``     — a successful connect is held for a 10-100 ms
+      jitter first (connection-setup latency).
+
+    Send-path faults (drop/delay/duplication of individual documents)
+    live in :func:`p2p_gossipprotocol_tpu.faults.wrap_send`, which
+    PeerNode layers over its wire send when the plan asks for them.
+    """
+
+    def __init__(self, ip: str, port: int, plan=None, rng=None):
+        super().__init__(ip, port)
+        import random as _random
+
+        self.plan = plan
+        self.rng = rng or _random.Random()
+
+    def connect_to(self, ip: str, port: int, timeout: float = 2.0
+                   ) -> socket.socket | None:
+        plan = self.plan
+        if plan is not None:
+            if plan.link_drop > 0.0 and self.rng.random() < plan.link_drop:
+                return None              # the virtual wire refused us
+            if plan.delay > 0.0 and self.rng.random() < plan.delay:
+                import time
+
+                time.sleep(self.rng.uniform(0.01, 0.1))
+        return self.connect(ip, port, timeout)

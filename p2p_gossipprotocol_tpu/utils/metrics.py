@@ -34,15 +34,19 @@ def emit_jsonl(rows: Iterable[Mapping], fp: IO[str], **common) -> int:
 def rows_from_result(res) -> list[dict]:
     """Per-round rows from a sim.SimResult (or anything exposing the same
     metric arrays)."""
+    redel = getattr(res, "redeliveries", None)
     out = []
     for i in range(len(res.coverage)):
-        out.append({
+        row = {
             "coverage": float(res.coverage[i]),
             "deliveries": int(res.deliveries[i]),
             "frontier_size": int(res.frontier_size[i]),
             "live_peers": int(res.live_peers[i]),
             "evictions": int(res.evictions[i]),
-        })
+        }
+        if redel is not None:
+            row["redeliveries"] = int(redel[i])
+        out.append(row)
     return out
 
 
@@ -57,6 +61,38 @@ def summarize(res, target: float = 0.99) -> dict:
         "msgs_per_sec": (float(res.deliveries.sum() / res.wall_s)
                          if res.wall_s else 0.0),
     }
+
+
+def degradation_summary(res, target: float = 0.99,
+                        plan=None) -> dict:
+    """Fault-tolerance summary of a (typically faulted) run — the
+    measurement the fault plane exists for: how gracefully does
+    dissemination degrade?
+
+    * ``final_coverage`` / ``rounds_to_<target>`` — coverage under
+      faults and the dissemination slowdown (compare against an
+      unfaulted run of the same seed to get the degradation delta);
+    * ``total_redeliveries`` — redundant receipts, the bandwidth price
+      of routing around lossy links (0 when the engine ran with
+      fuse_update, whose kernel never materializes the receive words);
+    * ``min_live_peers`` — the deepest crash/churn trough survived;
+    * ``recovered_peers`` — net peers regained from the trough to the
+      final round (the recovery schedules' observable).
+    """
+    redel = getattr(res, "redeliveries", None)
+    out = {
+        "final_coverage": float(res.coverage[-1]),
+        f"rounds_to_{target:g}": int(res.rounds_to(target)),
+        "total_deliveries": int(res.deliveries.sum()),
+        "total_redeliveries": (int(redel.sum())
+                               if redel is not None else None),
+        "min_live_peers": int(res.live_peers.min()),
+        "recovered_peers": int(res.live_peers[-1] - res.live_peers.min()),
+        "total_evictions": int(res.evictions.sum()),
+    }
+    if plan is not None:
+        out["fault_plan"] = plan.to_spec()
+    return out
 
 
 @contextlib.contextmanager

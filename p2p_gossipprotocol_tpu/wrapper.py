@@ -33,6 +33,7 @@ class Peer:
         self._thread: threading.Thread | None = None
         self._result = None
         if cfg.backend == "socket":
+            from p2p_gossipprotocol_tpu import faults as faults_lib
             from p2p_gossipprotocol_tpu.peer import PeerNode
 
             #: same attribute on both backends (the jax path sets the
@@ -48,6 +49,9 @@ class Peer:
                 powerlaw_alpha=cfg.powerlaw_alpha,
                 wire_format=cfg.wire_format,
                 anti_entropy_interval=cfg.anti_entropy_interval,
+                # the same plan the jax engines consume, mirrored on
+                # the wire (fault_* keys / --fault-plan)
+                fault_plan=faults_lib.plan_from_config(cfg),
             )
         else:
             self.node = None

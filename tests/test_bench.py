@@ -21,6 +21,10 @@ _BASE_ENV = {
     "GOSSIP_BENCH_PEERS": "16384",
     "GOSSIP_BENCH_MSGS": "8",
     "GOSSIP_BENCH_MAX_TRIES": "1",
+    # The failed-backend tests pin platform=tpu, whose init in this
+    # container hangs in C (libtpu metadata fetch); the subprocess probe
+    # kills it at this budget instead of eating the 420 s test timeout.
+    "GOSSIP_BENCH_PROBE_TIMEOUT_S": "20",
 }
 
 

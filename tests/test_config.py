@@ -218,10 +218,16 @@ def test_engine_key_default_and_invalid(tmp_path):
 def test_roll_groups_key(tmp_path):
     from p2p_gossipprotocol_tpu.config import NetworkConfig
     cfg = tmp_path / "net.txt"
-    cfg.write_text("10.0.0.1:8000\nroll_groups=4\n")
-    assert NetworkConfig(str(cfg)).roll_groups == 4
-    cfg.write_text("10.0.0.1:8000\n")
+    cfg.write_text("10.0.0.1:8000\nroll_groups=8\n")
+    assert NetworkConfig(str(cfg)).roll_groups == 8
+    cfg.write_text("10.0.0.1:8000\nroll_groups=0\n")
     assert NetworkConfig(str(cfg)).roll_groups == 0
+    # measured-best DEFAULTS (round-5 on-chip A/Bs): grouped rolls +
+    # windowed pull on; from_config degrades pull_window when a
+    # scenario can't support it
+    cfg.write_text("10.0.0.1:8000\n")
+    parsed = NetworkConfig(str(cfg))
+    assert parsed.roll_groups == 4 and parsed.pull_window == 1
 
 
 def test_config_parser_never_crashes_on_junk(tmp_path):

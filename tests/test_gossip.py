@@ -37,7 +37,7 @@ def test_push_flood_matches_bfs_oracle():
     seen_np = np.asarray(state.seen).copy()
     frontier_np = seen_np.copy()
     for _ in range(6):
-        state, _ = push_round(state, topo)
+        state, _, _ = push_round(state, topo)
         recv = adj.T @ frontier_np  # bool matmul: any sending in-neighbor
         recv = recv > 0
         new = recv & ~seen_np
@@ -53,7 +53,7 @@ def test_push_delivers_each_message_once_per_peer():
     topo, state = _mk(n=128)
     total = 0
     for _ in range(20):
-        state, d = push_round(state, topo)
+        state, d, _ = push_round(state, topo)
         total += int(d)
     seen = np.asarray(state.seen)
     # every delivery set a previously-unset seen bit
@@ -64,7 +64,7 @@ def test_push_coverage_monotone_and_complete():
     topo, state = _mk(n=256, avg=8)
     prev = 0
     for _ in range(16):
-        state, _ = push_round(state, topo)
+        state, _, _ = push_round(state, topo)
         cov = int(np.asarray(state.seen).sum())
         assert cov >= prev
         prev = cov
@@ -75,7 +75,7 @@ def test_push_coverage_monotone_and_complete():
 def test_pull_converges():
     topo, state = _mk(n=128, avg=8)
     for _ in range(64):
-        state, _ = pull_round(state, topo)
+        state, _, _ = pull_round(state, topo)
     assert np.asarray(state.seen).mean() > 0.95
 
 
@@ -83,10 +83,10 @@ def test_pushpull_faster_than_pull():
     topo, state = _mk(n=256, avg=8)
     st_pp = state
     for _ in range(8):
-        st_pp, _ = pushpull_round(st_pp, topo)
+        st_pp, _, _ = pushpull_round(st_pp, topo)
     st_pull = state
     for _ in range(8):
-        st_pull, _ = pull_round(st_pull, topo)
+        st_pull, _, _ = pull_round(st_pull, topo)
     assert (np.asarray(st_pp.seen).sum() >= np.asarray(st_pull.seen).sum())
 
 
@@ -95,7 +95,7 @@ def test_dead_peers_never_send_or_receive():
     dead = jnp.arange(64) < 32
     state = state.replace(alive=~dead)
     for _ in range(10):
-        state, _ = push_round(state, topo)
+        state, _, _ = push_round(state, topo)
     seen = np.asarray(state.seen)
     sources = np.asarray(init_gossip_state(
         topo, 4, jax.random.PRNGKey(0)).seen)
@@ -109,7 +109,7 @@ def test_byzantine_peers_receive_but_do_not_relay():
                               sources=jnp.array([0]))
     byz = jnp.zeros(6, bool).at[0].set(True)  # the source is byzantine
     state = state.replace(byzantine=byz)
-    state, d = push_round(state, topo)
+    state, d, _ = push_round(state, topo)
     assert int(d) == 0  # byzantine source never relays
 
 
@@ -118,8 +118,8 @@ def test_fanout_limits_spread_rate():
     st0 = init_gossip_state(topo, 1, jax.random.PRNGKey(1))
     st_flood = st0
     st_fan = st0
-    st_flood, _ = push_round(st_flood, topo)
-    st_fan, _ = push_round(st_fan, topo, fanout=2)
+    st_flood, _, _ = push_round(st_flood, topo)
+    st_fan, _, _ = push_round(st_fan, topo, fanout=2)
     assert (np.asarray(st_fan.seen).sum()
             <= np.asarray(st_flood.seen).sum())
 
@@ -129,6 +129,6 @@ def test_rounds_deterministic_given_key():
     a = state
     b = state
     for _ in range(5):
-        a, _ = pushpull_round(a, topo)
-        b, _ = pushpull_round(b, topo)
+        a, _, _ = pushpull_round(a, topo)
+        b, _, _ = pushpull_round(b, topo)
     assert (np.asarray(a.seen) == np.asarray(b.seen)).all()

@@ -8,7 +8,17 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: jax < 0.5 cannot run multi-process collectives on the CPU backend at
+#: all (XLA: "Multiprocess computations aren't implemented on the CPU
+#: backend") — the rehearsal is then an environment impossibility, not
+#: a code defect, and must read as a SKIP, not a red tier-1 entry.
+#: (matched without the apostrophe: the worker traceback reaches the
+#: driver's stdout inside a repr, which escapes it)
+_CPU_MULTIPROCESS_ERR = "Multiprocess computations aren"
 
 
 def test_two_process_distributed_rehearsal():
@@ -25,6 +35,10 @@ def test_two_process_distributed_rehearsal():
          "--rounds", "16"],     # windowed pull needs ~2 extra rounds
         capture_output=True, text=True, timeout=570, env=env,
         cwd=REPO_ROOT)
+    if proc.returncode != 0 and _CPU_MULTIPROCESS_ERR in (proc.stdout
+                                                          + proc.stderr):
+        pytest.skip("this jax/XLA build cannot run multi-process "
+                    "collectives on the CPU backend")
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
     artifact = json.loads(proc.stdout.strip().splitlines()[-1])
     assert artifact["ok"] is True

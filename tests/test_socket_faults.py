@@ -1,0 +1,235 @@
+"""Socket-backend fault plane: wire-level drop/delay/duplication
+(faults.wrap_send), connect-refusing FaultyTransport, and the resilient
+retry-with-backoff send path in peer.py — the path that used to lose a
+message forever on the first failed send (flood-once never retried).
+
+Module name contains "socket", so conftest's per-test SIGALRM guard
+covers every test here."""
+
+import json
+import random
+import socket
+import threading
+import time
+
+from p2p_gossipprotocol_tpu.faults import FaultPlan, wrap_send
+from p2p_gossipprotocol_tpu.info import PeerInfo
+from p2p_gossipprotocol_tpu.peer import PeerNode
+from p2p_gossipprotocol_tpu.transport.socket_transport import (
+    FaultyTransport, JsonStream, SocketTransport)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait(predicate, timeout=15.0, interval=0.05) -> bool:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# -- wrap_send ---------------------------------------------------------
+
+def test_wrap_send_drops_delays_duplicates():
+    sent = []
+    base = lambda sock, payload: sent.append(payload)
+    # full drop: nothing reaches the wire, and nothing raises
+    f = wrap_send(base, FaultPlan(link_drop=0.999999), random.Random(1))
+    for i in range(20):
+        f(None, {"i": i})
+    assert len(sent) <= 1
+    # full duplication: everything lands twice
+    sent.clear()
+    f = wrap_send(base, FaultPlan(duplicate=0.999999), random.Random(1))
+    for i in range(10):
+        f(None, {"i": i})
+    assert len(sent) == 20
+    # no wire faults -> the original function, unwrapped
+    assert wrap_send(base, FaultPlan(), random.Random(1)) is base
+    assert wrap_send(base, None, random.Random(1)) is base
+
+
+def test_wrap_send_is_seeded_deterministic():
+    plan = FaultPlan(link_drop=0.5, seed=3)
+    out1, out2 = [], []
+    f1 = wrap_send(lambda s, p: out1.append(p), plan, random.Random(9))
+    f2 = wrap_send(lambda s, p: out2.append(p), plan, random.Random(9))
+    for i in range(50):
+        f1(None, i)
+        f2(None, i)
+    assert out1 == out2 and 0 < len(out1) < 50
+
+
+# -- FaultyTransport ---------------------------------------------------
+
+def test_faulty_transport_refuses_connects():
+    listener = socket.socket()
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(1)
+    ip, port = listener.getsockname()
+    try:
+        t = FaultyTransport("127.0.0.1", _free_port(),
+                            plan=FaultPlan(link_drop=0.999999),
+                            rng=random.Random(0))
+        refused = sum(t.connect_to(ip, port) is None for _ in range(10))
+        assert refused >= 9
+        # a clean plan connects for real
+        ok = FaultyTransport("127.0.0.1", _free_port(), plan=FaultPlan(),
+                             rng=random.Random(0)).connect_to(ip, port)
+        assert ok is not None
+        ok.close()
+    finally:
+        listener.close()
+
+
+# -- the resilient send path ------------------------------------------
+
+class _Receiver:
+    """Minimal JSON peer endpoint: accepts connections, parses docs."""
+
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(5)
+        self.port = self.sock.getsockname()[1]
+        self.docs = []
+        self.running = True
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        while self.running:
+            try:
+                self.sock.settimeout(0.2)
+                conn, _ = self.sock.accept()
+            except (socket.timeout, OSError):
+                continue
+            threading.Thread(target=self._read, args=(conn,),
+                             daemon=True).start()
+
+    def _read(self, conn):
+        stream = JsonStream(conn)
+        while self.running:
+            objs = stream.recv_objects()
+            if objs is None:
+                return
+            self.docs.extend(objs)
+
+    def close(self):
+        self.running = False
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _mk_node(port=None):
+    node = PeerNode("127.0.0.1", port or _free_port(),
+                    seeds=[PeerInfo("127.0.0.1", 1)],
+                    rng=random.Random(0))
+    node.running = True    # send path only; no listener/loops started
+    return node
+
+
+def test_send_resilient_survives_dead_socket():
+    """A broadcast whose socket died mid-life must reconnect to the
+    peer's live listen port and deliver — the message is NOT lost (the
+    old path dropped it after one failed sendall)."""
+    rx = _Receiver()
+    node = _mk_node()
+    try:
+        key = ("127.0.0.1", rx.port)
+        sock = SocketTransport.connect(*key)
+        node.connected_peers[key] = sock
+        sock.close()    # the link dies; the peer stays up
+        ok = node._send_resilient(key, sock, {"type": "gossip", "n": 1})
+        assert ok, "resilient send gave up with the peer alive"
+        assert _wait(lambda: {"type": "gossip", "n": 1} in rx.docs)
+        # the replacement socket is registered for future sends
+        assert node.connected_peers[key] is not sock
+    finally:
+        node.running = False
+        rx.close()
+
+
+def test_send_resilient_survives_one_refused_connect():
+    """The acceptance case: the first reconnect attempt is refused (the
+    fault-injecting transport eats it), the backoff retry lands."""
+    rx = _Receiver()
+    node = _mk_node()
+    try:
+        # refuse exactly the first transport connect, pass the rest
+        class _RefuseOnce(random.Random):
+            calls = 0
+
+            def random(self):
+                _RefuseOnce.calls += 1
+                return 0.0 if _RefuseOnce.calls == 1 else 1.0
+
+        node.transport = FaultyTransport(
+            node.ip, node.port, plan=FaultPlan(link_drop=0.5),
+            rng=_RefuseOnce())
+        key = ("127.0.0.1", rx.port)
+        sock = SocketTransport.connect(*key)
+        node.connected_peers[key] = sock
+        sock.close()
+        assert node._send_resilient(key, sock, {"type": "gossip", "n": 2})
+        assert _wait(lambda: {"type": "gossip", "n": 2} in rx.docs)
+        assert _RefuseOnce.calls >= 2, "the refused connect never retried"
+    finally:
+        node.running = False
+        rx.close()
+
+
+def test_send_resilient_bounded_on_dead_peer():
+    """A genuinely dead peer exhausts the bounded retries and returns
+    False in ~sub-second time — the relay thread must not wedge."""
+    node = _mk_node()
+    try:
+        dead = ("127.0.0.1", _free_port())   # nothing listens here
+        t0 = time.time()
+        assert not node._send_resilient(dead, None, {"type": "gossip"})
+        assert time.time() - t0 < 10.0
+    finally:
+        node.running = False
+
+
+def test_broadcast_rolls_back_only_exhausted_targets():
+    """_broadcast books sent_to through the resilient path: delivered
+    peers stay booked, exhausted ones roll back for a future retry."""
+    from p2p_gossipprotocol_tpu.info import (Message, MessageTracker,
+                                             calculate_message_hash)
+
+    rx = _Receiver()
+    dying = _Receiver()
+    node = _mk_node()
+    try:
+        ok_key = ("127.0.0.1", rx.port)
+        dead_key = ("127.0.0.1", dying.port)
+        node.connected_peers[ok_key] = SocketTransport.connect(*ok_key)
+        dead_sock = SocketTransport.connect(*dead_key)
+        node.connected_peers[dead_key] = dead_sock
+        dying.close()       # the peer process dies: port gone
+        dead_sock.close()   # and the established link with it
+        msg = Message(content="x", timestamp="1", source_ip=node.ip,
+                      source_port=node.port, msg_number=0)
+        msg.hash = calculate_message_hash(msg)
+        node.message_list[msg.hash] = MessageTracker(msg)
+        node._broadcast(msg)
+        tracker = node.message_list[msg.hash]
+        assert ok_key in tracker.sent_to
+        assert dead_key not in tracker.sent_to
+        assert _wait(lambda: any(d.get("content") == "x"
+                                 for d in rx.docs))
+    finally:
+        node.running = False
+        rx.close()
