@@ -33,6 +33,12 @@ OUT = os.path.join(REPO, "benchmarks", "results",
 DEVS_PER_PROC = 4
 N_PROCS = 2
 
+#: jax < 0.5 cannot run multi-process collectives on the CPU backend at
+#: all — an environment impossibility, not a code defect.  Mirrors the
+#: SKIP guard in tests/test_multihost.py; matched without the apostrophe
+#: because the worker traceback may arrive escaped inside a repr.
+_CPU_MULTIPROCESS_ERR = "Multiprocess computations aren"
+
 # ONE definition of the rehearsed scenario, consumed by both worker()
 # (what actually runs) and the driver's recorded artifact (what the
 # JSON claims ran) — they can never drift apart.
@@ -126,7 +132,10 @@ def _attempt(rounds: int) -> tuple[list, list]:
             if ln.startswith("WORKER_RESULT "):
                 results.append(json.loads(ln[len("WORKER_RESULT "):]))
         if p.returncode != 0:
-            errors.append(f"worker rc={p.returncode}: {err[-2000:]}")
+            tail = err[-4000:]
+            if len(err) > 4000:  # cut at a line boundary, not mid-path
+                tail = tail.split("\n", 1)[-1]
+            errors.append(f"worker rc={p.returncode}: {tail}")
     return results, errors
 
 
@@ -140,6 +149,20 @@ def driver(rounds: int) -> int:
             break
         print(f"[multihost] attempt {attempt + 1} failed: "
               f"{errors[:1]}", file=sys.stderr)
+        if all(_CPU_MULTIPROCESS_ERR in e for e in errors):
+            break  # deterministic environment error — retries can't help
+
+    # Environment impossibility, not a code defect: leave any previously
+    # recorded artifact untouched (it may hold the last GREEN run from an
+    # environment that could execute the rehearsal) and exit with a
+    # distinct skip code.  The tier-1 test maps this marker to a SKIP.
+    if errors and all(_CPU_MULTIPROCESS_ERR in e for e in errors):
+        print(f"[multihost] SKIP ({_CPU_MULTIPROCESS_ERR}...): this "
+              "jax/XLA build cannot run multi-process collectives on "
+              "the CPU backend; artifact left untouched", file=sys.stderr)
+        print(json.dumps({"ok": False, "skipped": True,
+                          "errors": errors[:1]}))
+        return 3
 
     ok = (not errors and len(results) == N_PROCS
           and all(r["n_processes"] == N_PROCS
