@@ -33,7 +33,10 @@ GOSSIP_BENCH_ENGINE (aligned | edges), GOSSIP_BENCH_PLATFORM (pin a
 backend), GOSSIP_BENCH_FALLBACK_PEERS (256k), GOSSIP_BENCH_NO_FALLBACK,
 GOSSIP_BENCH_CHURN (0.05), GOSSIP_BENCH_LIVENESS_EVERY (3),
 GOSSIP_BENCH_ROLL_GROUPS (4), GOSSIP_BENCH_STAGGER (0),
-GOSSIP_BENCH_BLOCK_PERM (0), GOSSIP_BENCH_FUSE_UPDATE (0),
+GOSSIP_BENCH_BLOCK_PERM (auto: fused overlay at wide message widths,
+same rule as from_config; 0/1 forces), GOSSIP_BENCH_ROWBLK (auto:
+VMEM-budget block sizing — 2048-row blocks at W=1; an int pins it),
+GOSSIP_BENCH_FUSE_UPDATE (0),
 GOSSIP_BENCH_PULL_WINDOW (1 when roll-grouped pushpull; falls back to
 off when the overlay can't support it), GOSSIP_BENCH_CHECK_EVERY (1,
 clamped to [1, MAX_ROUNDS]), GOSSIP_BENCH_STEADY_ROUNDS (256 on TPU,
@@ -220,11 +223,35 @@ def _bench_aligned(n, n_msgs, degree, mode):
     # Staggered generation: message m enters at round m*k (the
     # reference's messageGenerationLoop cadence); 0 = all at round 0.
     stagger = int(os.environ.get("GOSSIP_BENCH_STAGGER", "0"))
-    # Block-perm overlay (fused kernels, zero per-pass prep) — opt-in
-    # until the on-chip A/B lands.
-    block_perm = bool(int(os.environ.get("GOSSIP_BENCH_BLOCK_PERM", "0")))
-    # In-kernel seen-update — opt-in (measured negative on chip).
+    # Block-perm overlay (fused kernels, zero per-pass prep): default
+    # AUTO, the same selection rule as from_config — fused at wide
+    # message widths (measured -43% ms/round at 1M x 256), row-perm at
+    # narrow ones (a wash at W=1).  GOSSIP_BENCH_BLOCK_PERM=0/1 forces.
+    from p2p_gossipprotocol_tpu.aligned import (AUTO_BLOCK_PERM_MIN_WORDS,
+                                                MAX_CONFIG_ROWBLK,
+                                                MAX_WORDS_X_ROWBLK,
+                                                n_msg_words)
+
+    bp_env = os.environ.get("GOSSIP_BENCH_BLOCK_PERM", "").strip()
+    if bp_env:
+        block_perm = bool(int(bp_env))
+    else:
+        block_perm = (n_msg_words(n_msgs) >= AUTO_BLOCK_PERM_MIN_WORDS
+                      and mode != "pull"
+                      and (roll_groups is None or roll_groups >= 2))
+    # In-kernel seen-update — opt-in (measured negative pre-census; the
+    # in-kernel census changes its economics — measure_round6 re-A/Bs).
     fuse_update = bool(int(os.environ.get("GOSSIP_BENCH_FUSE_UPDATE", "0")))
+    # VMEM row block: AUTO sizes it to the budget (wide blocks at small
+    # W — the block-sizing lever against the partial-reuse gap);
+    # GOSSIP_BENCH_ROWBLK pins it for A/Bs.
+    rb_env = os.environ.get("GOSSIP_BENCH_ROWBLK", "").strip()
+    if rb_env:
+        rowblk = int(rb_env)
+    else:
+        budget = MAX_WORDS_X_ROWBLK // (2 if fuse_update else 1)
+        rowblk = min(MAX_CONFIG_ROWBLK,
+                     max(8, budget // n_msg_words(n_msgs) // 8 * 8))
     # Windowed pull — DEFAULT ON since the on-chip A/Bs: -29.5% steady-
     # state ms/round on this exact config (256-round scans, the only
     # timing mode the tunnel can't distort), identical rounds and final
@@ -244,6 +271,7 @@ def _bench_aligned(n, n_msgs, degree, mode):
     t0 = time.perf_counter()
     topo = build_aligned(seed=0, n=n, n_slots=degree,
                          degree_law="powerlaw", roll_groups=roll_groups,
+                         n_msgs=n_msgs, rowblk=rowblk,
                          block_perm=block_perm)
     graph_s = time.perf_counter() - t0
     plan = _fault_plan()
@@ -313,6 +341,7 @@ def _bench_aligned(n, n_msgs, degree, mode):
         "liveness_every": liveness_every,
         "roll_groups": roll_groups,
         "faults": plan.to_spec() if plan else None,
+        "rowblk": topo.rowblk,
         **({"message_stagger": stagger} if stagger else {}),
         **({"block_perm": True} if block_perm else {}),
         **({"fuse_update": True} if fuse_update else {}),

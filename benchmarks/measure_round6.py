@@ -1,0 +1,172 @@
+"""Round-6 A/Bs: the auto-selected fused path vs the old default, the
+in-kernel census re-pricing of fuse_update, and the small-W row-block
+sizing — the direct measurements behind this round's three changes:
+
+1. AUTO PATH: from_config now selects `block_perm` at wide message
+   widths (config default block_perm=-1).  A/B at 1M x 256 (W=8):
+   the pre-round-6 default (row-perm overlay, rowblk 512) vs the
+   auto-selected path on the same scenario.  Acceptance: >= 15%
+   ms/round reduction on steady-state scans.
+2. CENSUS: fuse_update measured negative on chip WITHOUT the census
+   (round5 A/B: +1.5..+17%); the final pass now also emits the round
+   census as per-block popcount tiles, deleting the XLA 2W-plane
+   metrics re-read — re-A/B at 1M x 16 and 1M x 256.
+3. ROWBLK: W=1 rounds now default to 2048-row blocks (4x fewer grid
+   steps); A/B 512 vs 2048 at 1M x 16.
+
+Run on the chip (the watchdog chain step measure_round6):
+    PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/measure_round6.py
+Appends one JSON row per measurement to GOSSIP_R6_OUT (default
+benchmarks/results/round6_tpu.jsonl), resuming per-config like the
+round-4/5 drivers.
+
+Off-TPU the driver refuses by default (CPU rows must never pollute the
+TPU artifact); GOSSIP_R6_CPU=1 runs a reduced-scale CPU variant into
+round6_cpu.jsonl — interpret-mode kernels, so the absolute numbers
+mean nothing across platforms, but the A/B RATIOS exercise the same
+code paths (the prep/permute deletion is a real XLA op on CPU too).
+Scale knobs: GOSSIP_R6_PEERS (1M; CPU default 512k — the smallest
+scale where the 2048-row-block A/B still has >= 2 blocks per config),
+GOSSIP_R6_ROUNDS (256 on TPU; 24 on CPU, where interpret-mode kernels
+put a 256-round 1M x 256 scan at multiple hours).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: F401  (parity with sibling drivers)
+import jax
+
+
+def _out_path(cpu: bool) -> str:
+    default = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "results",
+        "round6_cpu.jsonl" if cpu else "round6_tpu.jsonl")
+    return os.environ.get("GOSSIP_R6_OUT", default)
+
+
+OUT = None          # set in main() once the platform is known
+
+
+def emit(row):
+    row["device"] = str(jax.devices()[0]).replace(" ", "_")
+    row["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    print(json.dumps(row), flush=True)
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def _landed() -> set:
+    from benchmarks._common import landed
+    return landed(OUT)
+
+
+def _steady(sim, rounds):
+    """Steady-state ms/round over a free-running scan (warm-up run
+    excluded — the only timing mode the tunnel's ~70 ms dispatch
+    constant can't distort) plus the model-effective bandwidth."""
+    res = sim.run(rounds, warmup=True)
+    ms = res.wall_s / rounds * 1e3
+    bpr = sim.hbm_bytes_per_round()
+    return {
+        "rounds": rounds,
+        "wall_s": round(res.wall_s, 4),
+        "steady_ms_per_round": round(ms, 3),
+        "final_coverage": round(float(res.coverage[-1]), 5),
+        "bytes_per_round": bpr,
+        "achieved_gb_s": round(bpr * rounds / res.wall_s / 1e9, 1)
+        if res.wall_s > 0 else None,
+        "rowblk": sim.topo.rowblk,
+    }
+
+
+def _mk(n, n_msgs, *, block_perm, rowblk, roll_groups=4,
+        fuse_update=False, pull_window=True):
+    from p2p_gossipprotocol_tpu.aligned import AlignedSimulator, \
+        build_aligned
+    from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+
+    topo = build_aligned(seed=7, n=n, n_slots=16, degree_law="powerlaw",
+                         roll_groups=roll_groups, n_msgs=n_msgs,
+                         rowblk=rowblk, block_perm=block_perm)
+    return AlignedSimulator(
+        topo=topo, n_msgs=n_msgs, mode="pushpull",
+        churn=ChurnConfig(rate=0.05, kill_round=1), max_strikes=3,
+        liveness_every=3, fuse_update=fuse_update,
+        pull_window=pull_window, seed=1)
+
+
+def bench_auto_path_ab(n, rounds, done):
+    """The tentpole acceptance A/B: old default vs the auto-selected
+    fused path, same scenario, 1M(-scale) x 256 messages."""
+    for tag, bp in (("auto_ab_256msg_default", False),
+                    ("auto_ab_256msg_auto", True)):
+        if tag in done:
+            continue
+        sim = _mk(n, 256, block_perm=bp, rowblk=512)
+        emit({"config": tag, "n_peers": n, "n_msgs": 256,
+              "block_perm": bp, **_steady(sim, rounds)})
+
+
+def bench_census_ab(n, rounds, done):
+    """fuse_update re-priced with the in-kernel census: the pre-census
+    on-chip verdict was +1.5..+17% ms/round — the census deletes the
+    2W-plane metrics re-read from the same configs."""
+    for n_msgs, bp, groups in ((16, False, 4), (256, True, 2)):
+        for fuse in (False, True):
+            tag = f"census_ab_{n_msgs}msg_fuse_{int(fuse)}"
+            if tag in done:
+                continue
+            # the fused update halves the VMEM row-block budget
+            blk = 256 if (fuse and n_msgs == 256) else 512
+            sim = _mk(n, n_msgs, block_perm=bp, roll_groups=groups,
+                      rowblk=blk, fuse_update=fuse)
+            emit({"config": tag, "n_peers": n, "n_msgs": n_msgs,
+                  "block_perm": bp, "fuse_update": fuse,
+                  **_steady(sim, rounds)})
+
+
+def bench_rowblk_ab(n, rounds, done):
+    """Small-W block sizing: 512 (legacy) vs 2048 (the new from_config
+    default at W=1) — 4x fewer grid steps, longer DMA streams."""
+    for blk in (512, 2048):
+        tag = f"rowblk_ab_16msg_{blk}"
+        if tag in done:
+            continue
+        sim = _mk(n, 16, block_perm=False, rowblk=blk)
+        emit({"config": tag, "n_peers": n, "n_msgs": 16,
+              **_steady(sim, rounds)})
+
+
+def main():
+    global OUT
+    backend = jax.default_backend()
+    on_tpu = backend in ("tpu", "axon")
+    cpu_ok = bool(int(os.environ.get("GOSSIP_R6_CPU", "0")))
+    if not on_tpu and not cpu_ok:
+        print(f"not on TPU (backend={backend}) — set GOSSIP_R6_CPU=1 "
+              "for a reduced-scale CPU run into round6_cpu.jsonl",
+              file=sys.stderr)
+        return 2
+    OUT = _out_path(cpu=not on_tpu)
+    n = int(os.environ.get("GOSSIP_R6_PEERS",
+                           str(1 << 20 if on_tpu else 1 << 19)))
+    rounds = int(os.environ.get("GOSSIP_R6_ROUNDS",
+                                "256" if on_tpu else "24"))
+    done = _landed()
+    if "_backend" not in done:
+        emit({"config": "_backend", "backend": backend, "n_peers": n,
+              "rounds": rounds})
+    bench_auto_path_ab(n, rounds, done)
+    bench_census_ab(n, rounds, done)
+    bench_rowblk_ab(n, rounds, done)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,7 +58,11 @@ def _check(name, fn):
 
 def _run_pair(mk_sim, rounds=6):
     """Run the same config compiled (Mosaic) and interpreted; assert the
-    end state is bitwise identical.  Returns the compiled result."""
+    end state AND the per-round census are bitwise identical (on
+    fuse_update configs the coverage/deliveries series come from the
+    round-6 in-kernel census — its partial-popcount tiles must
+    reproduce the interpreted values exactly).  Returns the compiled
+    result."""
     mosaic = mk_sim(False).run(rounds)
     interp = mk_sim(True).run(rounds)
     np.testing.assert_array_equal(np.asarray(mosaic.state.seen_w),
@@ -67,6 +71,10 @@ def _run_pair(mk_sim, rounds=6):
                                   np.asarray(interp.state.alive_b))
     np.testing.assert_array_equal(np.asarray(mosaic.topo.colidx),
                                   np.asarray(interp.topo.colidx))
+    np.testing.assert_array_equal(np.asarray(mosaic.coverage),
+                                  np.asarray(interp.coverage))
+    np.testing.assert_array_equal(np.asarray(mosaic.deliveries),
+                                  np.asarray(interp.deliveries))
     return mosaic
 
 

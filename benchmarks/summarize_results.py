@@ -6,13 +6,22 @@ Reads (whatever exists):
   results/round4_tpu.jsonl       — stride/roll-group A/B, 10M rows, SIR
   results/round5_tpu.jsonl       — prep-term / roll-reuse / block-perm /
                                    stagger microbenches
+  results/round6_tpu.jsonl       — auto-path / census / rowblk A/Bs
   results/baselines_tpu.jsonl    — the five BASELINE configs (appended)
 
 Prints a markdown summary ready for BASELINE.md plus machine verdicts:
 north-star vs the round-3 number, whether the roll-group VMEM reuse
 measured real, prep-term model-vs-measured, and the block-perm A/B.
 
-    python benchmarks/summarize_results.py
+Hygiene contract (round-6 satellite): every emitted row is NAMED and
+carries its payload — steady-state rows print steady_ms_per_round,
+bench-format rows (no "config" key) are named from their metric, and a
+row with nothing to show is omitted rather than printed as `{}`.
+
+    python benchmarks/summarize_results.py [OUT.md]
+
+With OUT.md the summary is also written to that file (the watchdog
+writes results/ROUND6_SUMMARY.md).
 """
 from __future__ import annotations
 
@@ -37,6 +46,32 @@ def rows(name):
                     out.append(json.loads(ln))
                 except json.JSONDecodeError:
                     continue
+    return out
+
+
+def row_name(r) -> str:
+    """Every row gets a real name: explicit config, else the bench
+    line's metric (suffixed with the message width, which is what
+    distinguishes re-runs of the same metric)."""
+    if r.get("config"):
+        return str(r["config"])
+    if r.get("metric"):
+        n_msgs = r.get("n_msgs")
+        return (f"{r['metric']}_x{n_msgs}msg" if n_msgs
+                else str(r["metric"]))
+    return "unnamed"
+
+
+def core_fields(r, keys) -> dict:
+    """The row's payload for the report — named keys first, and if none
+    of them are present, every scalar field except the boilerplate, so
+    no row ever prints as `{}`."""
+    out = {k: r[k] for k in keys if k in r and r[k] is not None}
+    if not out:
+        skip = {"config", "metric", "device", "ts", "platform", "unit"}
+        out = {k: v for k, v in r.items()
+               if k not in skip and not isinstance(v, (dict, list))
+               and v is not None}
     return out
 
 
@@ -74,12 +109,10 @@ def main() -> int:
     if r4:
         report.append("## Round-4 harness (stride x groups, 10M, SIR)")
         for r in r4:
-            cfg = r.get("config", "?")
-            core = {k: r[k] for k in ("rounds", "wall_s", "ms_per_round",
-                                      "final_coverage", "achieved_gb_s",
-                                      "peak_infected", "attack_rate")
-                    if k in r}
-            report.append(f"- `{cfg}`: {json.dumps(core)}")
+            core = core_fields(r, ("rounds", "wall_s", "ms_per_round",
+                                   "final_coverage", "achieved_gb_s",
+                                   "peak_infected", "attack_rate"))
+            report.append(f"- `{row_name(r)}`: {json.dumps(core)}")
 
     r5 = rows("round5_tpu.jsonl")
     if r5:
@@ -87,14 +120,14 @@ def main() -> int:
         kern = {r["config"]: r for r in r5
                 if r.get("config", "").startswith("kernel_only_rolls_")}
         for r in r5:
-            cfg = r.get("config", "?")
+            cfg = row_name(r)
             if cfg.startswith("_"):
                 continue
-            core = {k: r[k] for k in ("ms", "ms_per_round", "rounds",
-                                      "achieved_gb_s_vs_model",
-                                      "achieved_gb_s", "final_coverage",
-                                      "unique_rolls", "value")
-                    if k in r}
+            core = core_fields(r, ("ms", "ms_per_round", "rounds",
+                                   "achieved_gb_s_vs_model",
+                                   "achieved_gb_s", "final_coverage",
+                                   "unique_rolls", "value",
+                                   "steady_ms_per_round", "device_est_s"))
             report.append(f"- `{cfg}`: {json.dumps(core)}")
         k16 = kern.get("kernel_only_rolls_16", {}).get("ms")
         k4 = kern.get("kernel_only_rolls_4", {}).get("ms")
@@ -148,23 +181,65 @@ def main() -> int:
                                           "device_est_s") if k in r}
                 report.append(f"- CEILING `{tag}`: {json.dumps(core)}")
 
+    for fname, title in (
+            ("round6_tpu.jsonl",
+             "## Round-6 A/Bs (auto path, in-kernel census, "
+             "row-block sizing)"),
+            ("round6_cpu.jsonl",
+             "## Round-6 CPU A/Bs (interpret-mode kernels — ratios "
+             "exercise the code paths, absolute numbers and the "
+             "fused-path trade do NOT transfer to silicon; "
+             "docs/PERFORMANCE.md 'One honest negative')")):
+      r6 = rows(fname)
+      if r6:
+        report.append(title)
+        byname6 = {}
+        for r in r6:
+            cfg = row_name(r)
+            if cfg.startswith("_"):
+                continue
+            byname6[cfg] = r
+            core = core_fields(r, ("ms_per_round", "steady_ms_per_round",
+                                   "rounds", "final_coverage",
+                                   "bytes_per_round", "achieved_gb_s",
+                                   "rowblk", "block_perm", "fuse_update"))
+            report.append(f"- `{cfg}`: {json.dumps(core)}")
+        for label, off, on in (
+                ("auto fused path @ 256 msgs",
+                 "auto_ab_256msg_default", "auto_ab_256msg_auto"),
+                ("in-kernel census (fuse_update) @ 256 msgs",
+                 "census_ab_256msg_fuse_0", "census_ab_256msg_fuse_1"),
+                ("in-kernel census (fuse_update) @ 16 msgs",
+                 "census_ab_16msg_fuse_0", "census_ab_16msg_fuse_1"),
+                ("rowblk 2048 vs 512 @ 16 msgs",
+                 "rowblk_ab_16msg_512", "rowblk_ab_16msg_2048")):
+            a, b = byname6.get(off), byname6.get(on)
+            key = "steady_ms_per_round"
+            if a and b and a.get(key) and b.get(key):
+                cut = 1 - b[key] / a[key]
+                report.append(f"- VERDICT {label}: {a[key]} -> {b[key]} "
+                              f"ms/round ({cut:.1%})")
+
     base = rows("baselines_tpu.jsonl")
     if base:
         report.append("## Baseline configs (latest rows)")
         latest = {}
         for r in base:
-            latest[r.get("config")] = r
+            latest[row_name(r)] = r
         for cfg, r in latest.items():
-            core = {k: r[k] for k in ("n_peers", "value", "unit",
-                                      "wall_s", "rounds", "platform")
-                    if k in r}
+            core = core_fields(r, ("n_peers", "value", "unit",
+                                   "wall_s", "rounds", "platform"))
             report.append(f"- `{cfg}`: {json.dumps(core)}")
 
     if not report:
         print("no results found under benchmarks/results/",
               file=sys.stderr)
         return 1
-    print("\n".join(report))
+    text = "\n".join(report)
+    print(text)
+    if len(sys.argv) > 1:
+        with open(sys.argv[1], "w") as f:
+            f.write(text + "\n")
     return 0
 
 

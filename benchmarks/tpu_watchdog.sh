@@ -39,6 +39,38 @@ probe() {
 MAX_TRIES=6
 settled() { [ -e "$STAMPS/$1.done" ] || [ -e "$STAMPS/$1.gave_up" ]; }
 
+# Round-6 bench-refresh rule: a settled bench stamp must not freeze a
+# CPU-fallback (or stale) headline into the artifact while real TPU
+# windows come and go — BENCH_r0N.json was a CPU line two rounds
+# running because the stamp outlived the tunnel outage that caused it.
+# When a window is UP and the recorded line is not a live TPU result
+# (platform tpu/axon, a real value, fallback false) or is older than
+# GOSSIP_BENCH_REFRESH_S (default 6 h), clear the stamps so the bench
+# step re-runs inside this window.
+BENCH_JSON=benchmarks/results/bench_r5_tpu.json
+REFRESH_S=${GOSSIP_BENCH_REFRESH_S:-21600}
+bench_is_live() {
+  python - <<PY
+import json, os, sys, time
+p = "$BENCH_JSON"
+try:
+    rec = json.load(open(p))
+except Exception:
+    sys.exit(1)
+ok = (rec.get("platform") in ("tpu", "axon") and rec.get("value")
+      and not rec.get("fallback"))
+fresh = time.time() - os.path.getmtime(p) < $REFRESH_S
+sys.exit(0 if ok and fresh else 1)
+PY
+}
+maybe_refresh_bench() {
+  settled bench || return 0          # never-run bench takes the normal path
+  if ! bench_is_live; then
+    say "bench artifact is fallback/stale with the tunnel up — refreshing"
+    rm -f "$STAMPS/bench.done" "$STAMPS/bench.gave_up" "$STAMPS/bench.tries"
+  fi
+}
+
 # name | command | timeout.  Exit 0 = done (now or previously); exit 1 =
 # this attempt failed (caller decides whether it counts).
 run_step() {
@@ -67,7 +99,7 @@ record_fail() {
   fi
 }
 
-STEP_NAMES="bench mosaic_smoke measure_round4 measure_round5 baselines"
+STEP_NAMES="bench mosaic_smoke measure_round4 measure_round5 measure_round6 baselines"
 # Headline first: a short tunnel window must yield the most important
 # artifact.  bench keeps its file contract (ONE parsed line) and only
 # stamps when the line really came from the chip.
@@ -83,6 +115,7 @@ PY" ;;
     mosaic_smoke)   echo "python benchmarks/mosaic_smoke.py" ;;
     measure_round4) echo "python benchmarks/measure_round4.py" ;;
     measure_round5) echo "python benchmarks/measure_round5.py" ;;
+    measure_round6) echo "python benchmarks/measure_round6.py" ;;
     baselines)      echo "python benchmarks/run_baselines.py" ;;
   esac
 }
@@ -90,6 +123,7 @@ step_tmo() {
   case $1 in
     bench) echo 1800 ;; mosaic_smoke) echo 2400 ;;
     measure_round4) echo 4800 ;; measure_round5) echo 3600 ;;
+    measure_round6) echo 3600 ;;
     baselines) echo 4800 ;;
   esac
 }
@@ -98,6 +132,7 @@ say "watchdog v2 start (pid $$)"
 while true; do
   if probe; then
     say "tunnel UP — running unsettled steps"
+    maybe_refresh_bench
     for name in $STEP_NAMES; do
       settled "$name" && continue
       if ! run_step "$name" "$(step_cmd "$name")" "$(step_tmo "$name")"
@@ -110,12 +145,14 @@ while true; do
       fi
     done
     # Stand down only when every step settled AND the headline really
-    # landed on the chip — bench parked as gave_up is NOT enough (the
-    # v1 invariant: no TPU headline, no stand-down).
+    # landed on the chip AND is still live/fresh — bench parked as
+    # gave_up is NOT enough (the v1 invariant: no TPU headline, no
+    # stand-down), and a stale/fallback line keeps the watchdog on
+    # refresh duty so the next window re-captures it (round-6 rule).
     all=1
     for name in $STEP_NAMES; do settled "$name" || all=0; done
-    if [ "$all" = 1 ] && [ -e "$STAMPS/bench.done" ]; then
-      say "all steps settled — watchdog standing down"
+    if [ "$all" = 1 ] && [ -e "$STAMPS/bench.done" ] && bench_is_live; then
+      say "all steps settled, headline live — watchdog standing down"
       exit 0
     fi
   else

@@ -55,6 +55,31 @@ MAX_WORDS_X_ROWBLK = 4096
 # 64 int32 planes, far past every BASELINE config.
 MAX_CONFIG_MSGS = 2048
 
+# Calibrated partial-reuse leak for the kernels' resident-buffer y reuse
+# (round-5 kernel-only microbench, kernel_only_rolls_16/4: grouping 16
+# slots into 4 distinct rolls cut kernel time 1.47x where perfect reuse
+# predicts 2.3x).  A grid step whose y index repeats the previous step's
+# still costs this FRACTION of a full block stream — Mosaic's pipeline
+# re-issues part of the copy even for a resident block.  0.43 solves the
+# 16-vs-4-roll pair exactly (see docs/PERFORMANCE.md "Calibrating the y
+# term"); the 2-roll point measures BETTER than this (leak ~0), so the
+# calibrated model errs conservative (more modeled bytes, never fewer).
+Y_REUSE_LEAK = 0.43
+
+# from_config auto-selects the block-perm fused overlay at this message
+# width and above: the on-chip A/B (round5_tpu.jsonl) measured -43%
+# ms/round at W=8 (256 msgs) and a wash at W=1 (16 msgs) — the deleted
+# prep term scales with W, so the crossover sits between.
+AUTO_BLOCK_PERM_MIN_WORDS = 4
+
+# from_config's VMEM-budget row-block cap: at small W the budget admits
+# blocks far wider than the legacy 512 (W=1 -> 2048 rows/block), which
+# quarters the grid steps and the per-step DMA descriptor count — the
+# block-sizing lever against the partial-reuse gap the r5 microbench
+# exposed.  Capped at 2048 so y+acc (double-buffered) stay within half
+# the core VMEM even at W=1.
+MAX_CONFIG_ROWBLK = 2048
+
 
 def n_msg_words(n_msgs: int) -> int:
     """Message planes needed for ``n_msgs`` bit-packed rumors."""
@@ -131,6 +156,12 @@ class AlignedTopology:
     #: happen to coincide must still be rejected deterministically.
     roll_groups: int | None = struct.field(pytree_node=False,
                                            default=None)
+    #: calibrated partial-reuse leak the traffic model charges for grid
+    #: steps whose y index repeats the previous step's (Y_REUSE_LEAK has
+    #: the measurement); recorded on the topology so a future hardware
+    #: recalibration travels with the overlay it was measured on.
+    reuse_leak: float = struct.field(pytree_node=False,
+                                     default=Y_REUSE_LEAK)
 
     @property
     def rows(self) -> int:
@@ -148,7 +179,8 @@ def build_aligned(seed: int, n: int, n_slots: int = 16,
                   rowblk: int = 512, n_shards: int = 1,
                   n_msgs: int = 1,
                   roll_groups: int | None = None,
-                  block_perm: bool = False) -> AlignedTopology:
+                  block_perm: bool = False,
+                  reuse_leak: float = Y_REUSE_LEAK) -> AlignedTopology:
     """Sample an aligned overlay for ``n`` peers with ``n_slots`` in-edge
     slots per peer.
 
@@ -292,6 +324,7 @@ def build_aligned(seed: int, n: int, n_slots: int = 16,
         n_peers=n, n_slots=n_slots, rowblk=blk,
         ytab=None if ytab is None else jnp.asarray(ytab),
         roll_groups=None if roll_groups is None else n_groups,
+        reuse_leak=reuse_leak,
     )
 
 
@@ -340,11 +373,28 @@ def _popcount_pair(words: jax.Array) -> jax.Array:
 
 def _pair_total(pair: jax.Array) -> jax.Array:
     """float32 total from an (already reduced) [hi, lo] popcount pair.
-    One deterministic float op on exact ints — identical on every
-    sharding of the same global state (float32 carries 2.56e9 with
-    ~1e-7 relative error, far below any coverage threshold's needs)."""
-    return (pair[0].astype(jnp.float32) * 1024.0
-            + pair[1].astype(jnp.float32))
+    The pair is first NORMALIZED to the canonical (total >> 10,
+    total & 1023) decomposition, so any exact [hi, lo] split of the
+    same total — the jnp path's per-row split, the kernel census's
+    per-block split — yields the bit-identical float: one deterministic
+    rounding on exact ints, identical on every sharding of the same
+    global state (float32 carries 2.56e9 with ~1e-7 relative error,
+    far below any coverage threshold's needs)."""
+    hi = pair[0] + (pair[1] >> 10)
+    lo = pair[1] & 1023
+    return hi.astype(jnp.float32) * 1024.0 + lo.astype(jnp.float32)
+
+
+def _census_pair(partials: jax.Array) -> jax.Array:
+    """Exact [hi, lo] popcount pair from a kernel census output — the
+    int32[T, 8, 128] per-block partial tiles gossip_pass emits on the
+    census path (ops/aligned_kernel.py).  Per-block totals stay far
+    below 2^31 (<= W * blk * 128 * 32 <= 1.7e7 at the VMEM budget), and
+    the 1024 split keeps both halves psum-exact at any admissible scale
+    — the same discipline as :func:`_popcount_pair`."""
+    q = jnp.sum(partials, axis=(1, 2), dtype=jnp.int32)        # [T]
+    return jnp.stack([jnp.sum(q >> 10, dtype=jnp.int32),
+                      jnp.sum(q & 1023, dtype=jnp.int32)])
 
 
 def _pair_int(pair) -> int:
@@ -623,37 +673,73 @@ class AlignedSimulator:
                 n_msgs = MAX_CONFIG_MSGS - n_junk
             n_honest = n_msgs
             n_msgs = n_msgs + n_junk
+        # Fused-overlay AUTO-selection (the product path follows the
+        # measurements, zero knobs): block_perm=-1 (the config default)
+        # picks the block-granular overlay whenever it is measured-best
+        # AND legal — wide message sets (W >= AUTO_BLOCK_PERM_MIN_WORDS,
+        # the on-chip -43% regime; a wash at W=1 keeps row-perm there),
+        # push/pushpull modes (pure pull keeps the windowed classic
+        # path — no measurement says fusion beats it there), and a roll
+        # grouping that can express a block-level overlay (>= 2 distinct
+        # rolls).  An EXPLICIT block_perm=0/1 is honored, except that
+        # illegal combinations degrade with a recorded clamp instead of
+        # erroring the run — same seam as every other engine ceiling.
+        W = n_msg_words(n_msgs)
+        groups = cfg.roll_groups or None
+        if cfg.block_perm < 0:
+            block_perm = (W >= AUTO_BLOCK_PERM_MIN_WORDS
+                          and cfg.mode != "pull" and n_slots >= 2
+                          and (groups is None or groups >= 2))
+        else:
+            block_perm = bool(cfg.block_perm)
+        if block_perm and groups is not None and groups <= 1 \
+                and n_slots > 1:
+            clamps.append(
+                "block_perm with roll_groups=1 -> row-perm overlay "
+                "(a block-granular overlay needs >= 2 distinct block "
+                "rolls; one shared roll is a single permutation cycle "
+                "and dissemination stalls)")
+            block_perm = False
         # pull_window is DEFAULT-ON from the config surface (the
         # measured-best layout, VERDICT round-5 item 1) but remains an
         # optimization, not the scenario: when this configuration can't
-        # support it — push-only mode, an overlay that isn't roll-grouped
-        # with a >= 2-slot first group, or pure pull on a block-perm
-        # overlay (the single-cycle stall __post_init__ rejects) — fall
-        # back to the classic pull path instead of erroring the run.
+        # support it — push-only mode, or an overlay that isn't
+        # roll-grouped with a >= 2-slot first group — fall back to the
+        # classic pull path instead of erroring the run.  Pure pull on
+        # a block-perm overlay would stall on a single block cycle
+        # (__post_init__ rejects it); that degrade is RECORDED, since
+        # it weakens an explicitly configured combination.
         pull_window = bool(cfg.pull_window)
         if pull_window:
-            groups = cfg.roll_groups or 0
-            if (cfg.mode == "push" or not 1 <= groups <= n_slots // 2
-                    or (cfg.mode == "pull" and cfg.block_perm)):
+            g = groups or 0
+            if cfg.mode == "push" or not 1 <= g <= n_slots // 2:
                 pull_window = False
-        # n_msgs shrinks the kernel's VMEM row block for wide message
-        # sets; the fused update keeps twice the word-blocks resident,
-        # so its row block is bounded by the HALVED budget directly
-        # (doubling n_msgs instead under-shrinks whenever n_msg_words(2m)
-        # lands at 2w-1 — e.g. 129 messages: 258 msgs -> 9 words ->
-        # rowblk 448, but 5 words x 448 busts the 2048 budget).
-        rowblk = 512
-        if cfg.fuse_update:
-            rowblk = min(512, max(
-                8, (MAX_WORDS_X_ROWBLK // 2) // n_msg_words(n_msgs)
-                // 8 * 8))
+            elif cfg.mode == "pull" and block_perm:
+                clamps.append(
+                    "pull_window with mode=pull on a block_perm "
+                    "overlay -> classic pull (windowed anti-entropy "
+                    "would be confined to one block cycle)")
+                pull_window = False
+        # n_msgs sizes the kernel's VMEM row block: wide message sets
+        # shrink it (W * rowblk <= budget), and NARROW ones now widen it
+        # up to MAX_CONFIG_ROWBLK — fewer grid steps and longer DMA
+        # streams, the block-sizing lever against the partial-reuse gap
+        # (W=1 -> 2048-row blocks vs the legacy 512).  The fused update
+        # keeps twice the word-blocks resident, so its row block is
+        # bounded by the HALVED budget directly (doubling n_msgs
+        # instead under-shrinks whenever n_msg_words(2m) lands at 2w-1
+        # — e.g. 129 messages: 258 msgs -> 9 words -> rowblk 448, but 5
+        # words x 448 busts the 2048 budget).
+        budget = MAX_WORDS_X_ROWBLK // (2 if cfg.fuse_update else 1)
+        rowblk = min(MAX_CONFIG_ROWBLK,
+                     max(8, budget // n_msg_words(n_msgs) // 8 * 8))
         topo = build_aligned(seed=cfg.prng_seed, n=n, n_slots=n_slots,
                              degree_law=law,
                              powerlaw_alpha=cfg.powerlaw_alpha,
                              n_shards=n_shards, n_msgs=n_msgs,
                              rowblk=rowblk,
                              roll_groups=cfg.roll_groups or None,
-                             block_perm=bool(cfg.block_perm))
+                             block_perm=block_perm)
         return cls(topo=topo, n_msgs=n_msgs, mode=cfg.mode,
                    fanout=cfg.fanout,
                    churn=ChurnConfig(rate=cfg.churn_rate),
@@ -679,89 +765,106 @@ class AlignedSimulator:
                    seed=cfg.prng_seed)
 
     # ------------------------------------------------------------------
-    def hbm_bytes_per_round(self) -> int:
-        """Analytic HBM traffic model for one average round — the
+    def traffic_model(self) -> dict:
+        """Per-term analytic HBM model for one average round — the
         denominator behind the bench line's ``achieved_gb_s`` (measured
         wall-clock per round vs bytes this model says the round moves,
         comparable against the chip's ~800 GB/s HBM roof).
 
-        Counts, per pallas pass, each block the grid streams exactly once
-        (a block whose index map is constant across the inner grid dim
-        stays resident in VMEM and is counted once): the gossip pass
-        streams the packed sender planes D times (one roll per slot),
-        the lane tables once; the liveness pass (amortized over
-        ``liveness_every``) streams the alive plane D times plus
-        colidx/strikes in and out; plus the XLA-side prep (permute
-        gather, frontier masking, popcount metrics) at one read+write
-        per touched plane."""
-        R = self.topo.rows
-        D = self.topo.n_slots
-        W = self.n_words
-        plane = R * LANES * 4            # one int32[R, 128] plane
-        word_planes = W * plane          # int32[W, R, 128]
-        slot8 = D * R * LANES            # one int8[D, R, 128] table
-        # Effective y streams per pass: consecutive slots sharing a block
-        # roll are served from the resident VMEM buffer (build_aligned
-        # roll_groups), so only roll CHANGES cost a DMA.
-        rolls = np.asarray(self.topo.rolls)
-        y_streams = int(1 + (np.diff(rolls) != 0).sum()) if D > 1 else 1
+        Kernel terms replay the grid's actual DMA-descriptor sequence
+        (ops/aligned_kernel.stream_plan): a block whose index map
+        repeats the previous grid step's is served from the resident
+        VMEM buffer, but is still charged the topology's calibrated
+        ``reuse_leak`` fraction of a stream — the round-5 kernel-only
+        microbench measured the resident-buffer reuse PARTIAL (16->4
+        distinct rolls cut kernel time 1.47x where perfect reuse
+        predicts 2.3x; Y_REUSE_LEAK has the derivation).  XLA-side
+        passes (permute/mask prep, the elementwise update, the popcount
+        metrics) are charged one read+write per touched plane; on the
+        fused-update path the update AND the census live inside the
+        final kernel pass (per-block partial-popcount outputs) and
+        those terms drop to the small per-peer planes.
 
-        fused = self.topo.ytab is not None
+        Returns ``{term: bytes, ..., "total": bytes}``;
+        :meth:`hbm_bytes_per_round` is the total."""
+        from p2p_gossipprotocol_tpu.ops.aligned_kernel import stream_plan
 
-        def pass_bytes(streams, n_slots_d):
-            b = (streams * word_planes    # y per distinct roll
-                 + n_slots_d * R * LANES  # colidx rows the grid visits
-                 + R * LANES              # gate
-                 + word_planes)           # OR-accumulator out
+        topo = self.topo
+        R, D, W, C = topo.rows, topo.n_slots, self.n_words, LANES
+        blk = topo.rowblk
+        T = R // blk
+        plane = R * C * 4                # one int32[R, 128] plane
+        wp = W * plane                   # int32[W, R, 128]
+        slot8 = D * R * C                # one int8[D, R, 128] table
+        fused = topo.ytab is not None
+        fin = self.fuse_update
+        leak = topo.reuse_leak
+        rolls = np.asarray(topo.rolls)
+        ytab = None if topo.ytab is None else np.asarray(topo.ytab)
+
+        def y_eff(plan):
+            # calibrated partial reuse: full streams for index changes,
+            # leak-fraction streams for resident-buffer re-serves
+            return plan["y"] + leak * (plan["y_naive"] - plan["y"])
+
+        def pass_bytes(n_slots_d, final, seeded):
+            plan = stream_plan(rolls, T, ytab=ytab, n_slots=n_slots_d)
+            eff = y_eff(plan)
+            b = eff * W * blk * C * 4    # packed sender planes
+            b += plan["tab"] * blk * C   # colidx (int8)
+            b += plan["row"] * blk * C   # gate (int8)
+            b += wp                      # OR-accumulator out
             if fused:
-                # block-perm overlay: NO host-side permute/mask pass —
-                # the kernel reads raw state planes through the ytab
-                # index table; the cost is the src_ok mask plane
-                # streamed per distinct roll instead
-                b += streams * plane
+                b += eff * blk * C * 4   # src_ok rides each y fetch
+            if final:
+                # in-kernel seen-update + census: seen in, seen' out,
+                # rmask + census-ok planes, the partial-popcount tiles
+                b += 2 * wp + 2 * plane + 2 * T * 8 * C * 4
+            if seeded:
+                b += wp                  # pushpull acc_init re-read
             return b
 
-        prep = 0 if fused else 3 * word_planes    # mask + permute gather
-        # Pull-window: the pull pass runs a window-sized grid whose
-        # slots share one block roll — one seen-plane stream, and only
-        # the window's colidx rows.
-        pull_streams = (1 if self.pull_window else y_streams)
-        pull_slots = self._pull_slots
-        if self.mode == "pushpull":
-            total = pass_bytes(y_streams, D) + pass_bytes(pull_streams,
-                                                          pull_slots) \
-                + 2 * prep
-            n_passes = 2
-        elif self.mode == "pull":
-            total = pass_bytes(pull_streams, pull_slots) + prep
-            n_passes = 1
-        else:
-            total = pass_bytes(y_streams, D) + prep
-            n_passes = 1
-        if self.fanout > 0:
-            total += R * LANES                    # shift plane
+        terms = {}
+        if self.mode in ("push", "pushpull"):
+            terms["push_pass"] = pass_bytes(
+                D, final=fin and self.mode == "push", seeded=False)
+        if self.mode in ("pull", "pushpull"):
+            # Pull-window: a window-sized grid whose slots share one
+            # block roll — the replay sees the single stream directly.
+            terms["pull_pass"] = pass_bytes(
+                self._pull_slots, final=fin,
+                seeded=fin and self.mode == "pushpull")
+        n_passes = len(terms)
+        # XLA-side mask + permute gather per non-fused pass
+        terms["prep"] = 0 if fused else 3 * wp * n_passes
+        if self.fanout > 0 and self.mode != "pull":
+            terms["fanout_shift"] = R * C          # int8 shift plane
         if self._liveness:
-            liveness = (y_streams * plane         # alive plane per roll
-                        + 4 * slot8               # colidx/strikes r+w
-                        + 2 * slot8               # evict8 write + reduce
-                        + (plane if fused else 3 * plane))  # gather/prep
-            total += liveness // self.liveness_every
-        # Post-pass state update + metric reductions.  Metrics read the
-        # fresh ``new`` (deliveries popcount) and ``seen`` (coverage
-        # popcount) planes either way.
-        metrics = 2 * word_planes
-        if self.fuse_update:
-            # In-kernel: the final pass streams seen in + seen' out and
-            # the rmask plane; pushpull re-reads the push receive as the
-            # pull accumulator seed.  No XLA elementwise update exists.
-            total += 2 * word_planes + plane + metrics
-            if self.mode == "pushpull":
-                total += word_planes
+            plan = stream_plan(rolls, T, ytab=ytab)
+            lv = (y_eff(plan) * blk * C * 4   # alive plane per y fetch
+                  + 4 * slot8                 # colidx/strikes r+w
+                  + 2 * slot8                 # evict8 write + reduce
+                  + (plane if fused else 3 * plane))   # gather/prep
+            terms["liveness"] = lv // self.liveness_every
+        if fin:
+            # update + census are inside the final pass; what remains
+            # XLA-side are the small per-peer planes (ok/live popcounts)
+            terms["update"] = 0
+            terms["metrics"] = 2 * plane
         else:
             # XLA elementwise update: read each pass's receive words,
-            # read seen, write new + seen'.
-            total += (n_passes + 3) * word_planes + metrics
-        return int(total)
+            # read seen, write new + seen'; metrics re-read the fresh
+            # new (deliveries) and seen (coverage) planes
+            terms["update"] = (n_passes + 3) * wp
+            terms["metrics"] = 2 * wp + 2 * plane
+        terms = {k: int(v) for k, v in terms.items()}
+        terms["total"] = sum(terms.values())
+        return terms
+
+    def hbm_bytes_per_round(self) -> int:
+        """Total of :meth:`traffic_model` — the single number bench.py
+        divides wall-clock by for ``achieved_gb_s``."""
+        return self.traffic_model()["total"]
 
     # ------------------------------------------------------------------
     def _message_plan(self):
@@ -1146,12 +1249,19 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
                        if defer_w is not None else src_ok)
     # In-kernel seen-update (sim.fuse_update): the FINAL pass of the
     # round takes the receiver's seen planes + receive mask and emits
-    # (new, seen') straight from its VMEM-resident accumulator; in
+    # (new, seen') straight from its VMEM-resident accumulator — plus
+    # the round CENSUS as per-block partial-popcount tiles (deliveries
+    # bits of ``new``, coverage bits of ``seen' & ok & hmask``), so the
+    # XLA-side 2W-plane metrics re-read does not exist on this path; in
     # pushpull the push receive seeds the pull accumulator.  Dead peers
     # don't receive either way (the link is gone — gossip.py:_advance).
     fin = sim.fuse_update
     rmask_w = (topo.valid_w & alive_w) if fin else None
+    # ok = live, honest, valid — the coverage row filter (edge engine's
+    # coverage_of); feeds the in-kernel census and the n_ok denominator.
+    ok_w = alive_w & ~state.byz_w & topo.valid_w
     new = seen = None
+    dpb = cpb = None
     deferred_w = None
     if defer_w is not None:
         # The would-have-been relays a deferred peer holds back: they
@@ -1186,12 +1296,14 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
                            src_ok=src_ok_push if fused else None,
                            seen=seen_w if push_final else None,
                            rmask=rmask_w if push_final else None,
+                           census_ok=ok_w if push_final else None,
+                           census_hmask=hmask if push_final else None,
                            fault_meta=fmeta_push if kf else None,
                            gbase=gbase_f if kf else None,
                            rowblk=topo.rowblk,
                            interpret=sim.interpret)
         if push_final:
-            new, seen = recv
+            new, seen, dpb, cpb = recv
     elif not fin:               # pure anti-entropy pull
         recv = jnp.zeros_like(seen_w)
     if sim.mode in ("pull", "pushpull"):
@@ -1221,12 +1333,14 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
                                        sim.mode == "pushpull" else None),
                              seen=seen_w if fin else None,
                              rmask=rmask_w,
+                             census_ok=ok_w if fin else None,
+                             census_hmask=hmask if fin else None,
                              fault_meta=fmeta_pull if kf else None,
                              gbase=gbase_f if kf else None,
                              rowblk=topo.rowblk,
                              interpret=sim.interpret)
         if fin:
-            new, seen = pulled
+            new, seen, dpb, cpb = pulled
         else:
             recv = recv | pulled
 
@@ -1247,12 +1361,16 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
     # surface parity with sim.Simulator's metric dict.  Totals ride the
     # exact [hi, lo] int pair through the cross-shard reduction (a flat
     # int32 popcount wraps at the 10M x 256 scale) and become one
-    # float32 only after it — bitwise-identical on every sharding.
-    deliveries = _pair_total(msg_reduce(_popcount_pair(new)))
-    # Coverage over honest columns of LIVE HONEST peers — the edge
-    # engine's coverage_of (sim.py:33-43).  Each ok peer contributes 32
-    # bits to popcount(ok_w), hence the >> 5 peer count.
-    ok_w = alive_w & ~state.byz_w & topo.valid_w
+    # float32 only after it — bitwise-identical on every sharding.  On
+    # the fused path both censuses come from the kernel's per-block
+    # partial tiles instead of a 2W-plane re-read; _pair_total's
+    # canonical normalization makes the two decompositions produce the
+    # bit-identical float at any scale.
+    deliveries = _pair_total(msg_reduce(
+        _census_pair(dpb) if fin else _popcount_pair(new)))
+    # Coverage over honest columns of LIVE HONEST peers (ok_w above) —
+    # the edge engine's coverage_of (sim.py:33-43).  Each ok peer
+    # contributes 32 bits to popcount(ok_w), hence the >> 5 peer count.
     # 32 bits per ok peer, so a flat int32 popcount wraps at exactly
     # 2^26 peers (the 64M probe: n_ok collapsed to 1, coverage 8.0).
     # The [hi, lo] pair rides the cross-shard reduce exactly; the final
@@ -1279,8 +1397,9 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
             1).astype(jnp.float32)
     else:
         n_cols = jnp.float32(sim._n_honest)
-    coverage = (_pair_total(msg_reduce(_popcount_pair(
-        seen & ok_w[None] & hmask[:, None, None])))
+    coverage = (_pair_total(msg_reduce(
+        _census_pair(cpb) if fin else _popcount_pair(
+            seen & ok_w[None] & hmask[:, None, None])))
                 / (n_ok * n_cols))
     live = _pair_total(reduce(_popcount_pair(
         alive_w & topo.valid_w))) / 32.0

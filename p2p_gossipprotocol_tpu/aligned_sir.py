@@ -121,7 +121,7 @@ class AlignedSIRSimulator:
                              # SIR round takes the legacy (prow) route
                              # either way — count_pass is one flag
                              # plane, so there is no 3W prep to fuse
-                             block_perm=bool(cfg.block_perm))
+                             block_perm=cfg.block_perm > 0)
         return cls(topo=topo, beta=cfg.sir_beta, gamma=cfg.sir_gamma,
                    churn=ChurnConfig(rate=cfg.churn_rate),
                    seed=cfg.prng_seed)

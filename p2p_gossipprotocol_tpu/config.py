@@ -106,7 +106,8 @@ _SIM_INT_KEYS = {
     "roll_groups": "roll_groups",
     # aligned engine: 1 = block-granular permutation overlay — perm∘roll
     # rides the kernels' index table, eliminating the per-pass
-    # permute/mask prep entirely (build_aligned(block_perm=True)).
+    # permute/mask prep entirely (build_aligned(block_perm=True));
+    # -1 (default) = auto-select it when measured-best and legal.
     "block_perm": "block_perm",
     # aligned engine: 1 = fold the seen-update into the final gossip
     # pass (the kernel emits (new, seen') from its resident accumulator
@@ -207,10 +208,20 @@ class NetworkConfig:
         # -29.5% steady-state ms/round at 1M — and from_config falls
         # back to the classic pull path when a scenario can't support
         # the window (push-only mode, un-groupable overlays).
-        # block_perm/fuse_update stay opt-in (a wash / measured
-        # negative at typical widths).
+        # block_perm AUTO-selects (round 6): the fused overlay was
+        # measured -43% ms/round at 1M x 256 and a wash at W=1, so
+        # from_config picks it at wide message widths and keeps the
+        # row-perm family narrow.  fuse_update stays opt-in (measured
+        # negative pre-census; re-A/B'd with the in-kernel census by
+        # benchmarks/measure_round6.py).
         self.roll_groups = 4           # aligned engine; 0 = per-slot rolls
-        self.block_perm = 0            # aligned engine; 1 = fused overlay
+        # aligned engine: -1 = AUTO (the default — from_config selects
+        # the fused block-perm overlay whenever it is measured-best and
+        # legal: wide message sets, push/pushpull, >= 2 distinct rolls);
+        # 0/1 force it off/on, with illegal combinations degraded and
+        # recorded rather than errored (aligned.AlignedSimulator
+        # .from_config).
+        self.block_perm = -1
         self.fuse_update = 0           # aligned engine; 1 = in-kernel seen|new
         self.pull_window = 1           # aligned engine; 0 = classic pull
         self.rounds = 0
@@ -349,11 +360,14 @@ class NetworkConfig:
         if not is_valid_port(self.local_port):
             raise ConfigError(f"Invalid local_port: {self.local_port}")
         for k in ("n_peers", "n_messages", "avg_degree", "ba_m", "fanout",
-                  "roll_groups", "block_perm", "fuse_update", "pull_window",
+                  "roll_groups", "fuse_update", "pull_window",
                   "rounds", "prng_seed", "anti_entropy_interval",
                   "message_stagger", "mesh_devices", "msg_shards"):
             if getattr(self, k) < 0:
                 raise ConfigError(f"{k} must be non-negative")
+        if self.block_perm < -1:
+            # -1 = auto-select (the default); 0/1 force off/on
+            raise ConfigError("block_perm must be -1 (auto), 0, or 1")
         # msg_shards/mesh_devices CROSS-field rules are deliberately not
         # checked here: CLI flags may override engine/mode/mesh after
         # load, so the combination is validated at engine-selection time

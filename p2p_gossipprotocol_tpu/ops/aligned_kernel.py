@@ -47,6 +47,8 @@ from __future__ import annotations
 
 import functools
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
@@ -55,11 +57,31 @@ from jax.experimental.pallas import tpu as pltpu
 LANES = 128
 
 
+def _fold8(x):
+    """(blk, 128) int32 -> one (8, 128) partial-sum tile: sublane s holds
+    the sum over rows r ≡ s (mod 8) — the census outputs' on-chip layout
+    (an 8x128 tile is the smallest int32 store Mosaic tiles cleanly).
+    Consumers only ever SUM the partials, so the layout is free to
+    change with the block size; non-8-multiple blocks (interpret-mode
+    toy shapes only) collapse into sublane 0 instead."""
+    blk, C = x.shape
+    if blk % 8 == 0:
+        return jnp.sum(x.reshape(blk // 8, 8, C), axis=0)
+    tot = jnp.sum(x, axis=0, keepdims=True)
+    row = jax.lax.broadcasted_iota(jnp.int32, (8, C), 0)
+    return jnp.where(row == 0, jnp.broadcast_to(tot, (8, C)), 0)
+
+
 def _pass_kernel(pull: bool, n_planes: int, fanout: int, masked: bool,
-                 has_init: bool, finalize: bool, faulty: bool,
-                 n_pref: int, *refs):
+                 has_init: bool, finalize: bool, census: bool,
+                 faulty: bool, n_pref: int, *refs):
     pref, rest = refs[:n_pref], refs[n_pref:]
     subrolls_ref = pref[1]        # pref[0]=rolls, pref[2]=ytab (fused)
+    if census:
+        # Per-plane honest-column masks (int32[W] scalar prefetch) for
+        # the in-kernel coverage census; rides directly after the
+        # overlay tables, before the optional fault prefetch.
+        hmask_ref = pref[3 if masked else 2]
     if faulty:
         # Fault-plane scalar prefetch (faults.kernel_meta): gbase gives
         # each block's first GLOBAL row id (the liveness pass's shard-
@@ -92,9 +114,16 @@ def _pass_kernel(pull: bool, n_planes: int, fanout: int, masked: bool,
         # write-seen elementwise pass disappears.
         seen_ref, rmask_ref = rest[i], rest[i + 1]
         i += 2
+    if census:
+        # Census ok mask (-1 = live honest valid receiver): the coverage
+        # numerator's row filter, one d-constant block per row block.
+        cok_ref = rest[i]
+        i += 1
     acc_ref = rest[i]
     if finalize:
         seen_out_ref = rest[i + 1]
+    if census:
+        deliv_out_ref, cov_out_ref = rest[i + 2], rest[i + 3]
     d = pl.program_id(1)
     # Per-slot sublane roll: out-row i reads y-row (i + s_d) % blk, so a
     # peer's D slots see D distinct source rows even when the grid has a
@@ -158,12 +187,33 @@ def _pass_kernel(pull: bool, n_planes: int, fanout: int, masked: bool,
         def _(w=w, z=z):
             acc_ref[w] = acc_ref[w] | z
 
-        if finalize:
-            @pl.when(d == n_slots - 1)
-            def _(w=w):
+    if finalize:
+        @pl.when(d == n_slots - 1)
+        def _():
+            # Seen-update + (optionally) the round census, all from the
+            # VMEM-resident accumulator: per-plane popcounts of the
+            # delta (deliveries / frontier size) and of the updated
+            # seen planes under the receiver-ok and honest-column masks
+            # (the coverage numerator) fold into one 8x128 partial tile
+            # per row block — the XLA-side 2W-plane metrics re-read
+            # does not exist on this path.
+            dsum = csum = None
+            if census:
+                dsum = jnp.zeros((blk, LANES), jnp.int32)
+                csum = jnp.zeros((blk, LANES), jnp.int32)
+                cok = cok_ref[:]
+            for w in range(n_planes):
                 new = acc_ref[w] & rmask_ref[:] & ~seen_ref[w]
+                seen2 = seen_ref[w] | new
                 acc_ref[w] = new
-                seen_out_ref[w] = seen_ref[w] | new
+                seen_out_ref[w] = seen2
+                if census:
+                    dsum = dsum + jax.lax.population_count(new)
+                    csum = csum + jax.lax.population_count(
+                        seen2 & cok & hmask_ref[w])
+            if census:
+                deliv_out_ref[0] = _fold8(dsum)
+                cov_out_ref[0] = _fold8(csum)
 
 
 def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
@@ -175,6 +225,8 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
                 acc_init: jax.Array | None = None,
                 seen: jax.Array | None = None,
                 rmask: jax.Array | None = None,
+                census_ok: jax.Array | None = None,
+                census_hmask: jax.Array | None = None,
                 fault_meta: jax.Array | None = None,
                 gbase: jax.Array | None = None,
                 rowblk: int = 512,
@@ -219,6 +271,18 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
                 ``new = acc & rmask & ~seen`` and ``seen' = seen | new``
                 — replacing the XLA elementwise update (the traffic
                 model's seen|new term).
+    ``census_ok``/``census_hmask`` — OPTIONAL in-kernel round census
+                (requires ``seen``): ``census_ok`` int32[R, 128] is the
+                coverage row filter (-1 = live honest valid receiver),
+                ``census_hmask`` int32[W] the per-plane honest-column
+                masks (scalar prefetch).  The final slot also emits two
+                int32[T, 8, 128] per-block partial-popcount tiles —
+                deliveries bits (popcount of ``new``) and coverage bits
+                (popcount of ``seen' & ok & hmask``) — straight from
+                the VMEM-resident accumulator, deleting the XLA-side
+                2W-plane metrics re-read.  Partials are exact int32
+                (each <= W * blk/8 * 32 bits); callers reduce them with
+                the overflow-safe [hi, lo] pair discipline.
     ``fault_meta``/``gbase`` — OPTIONAL link-fault gate
                 (faults.kernel_meta): ``fault_meta`` int32[5] = [round,
                 hash seed, drop threshold, partition group mask,
@@ -240,9 +304,14 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
     fanout = 0 if pull else fanout
     fused = ytab is not None
     finalize = seen is not None
+    census = census_hmask is not None
     faulty = fault_meta is not None
     if finalize:
         assert rmask is not None, "in-kernel seen-update needs rmask"
+    if census:
+        assert finalize, "the in-kernel census rides the seen-update"
+        assert census_ok is not None, "census needs its ok mask"
+        assert census_hmask.shape == (W,), (census_hmask.shape, W)
     if faulty:
         assert gbase is not None, "the fault gate needs gbase"
         assert gbase.shape == (T,), (gbase.shape, T)
@@ -263,6 +332,12 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
         y_map = lambda t, d, k, s, *_: (0, (t + k[d]) % Ty, 0)
         tab_map = lambda t, d, k, s, *_: (d, t, 0)
         row_map = lambda t, d, k, s, *_: (t, 0)
+    if census:
+        # int32[W] plane masks — scalar prefetch (SMEM), read per plane
+        # in the finalize block.  Appended BEFORE the fault operands so
+        # the kernel's pref[-2:]/pref[2|3] positions both stay stable.
+        prefetch = prefetch + (census_hmask,)
+        n_pref += 1
     if faulty:
         prefetch = prefetch + (gbase, fault_meta)
         n_pref += 2
@@ -290,10 +365,21 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
         operands.append(seen)
         in_specs.append(pl.BlockSpec((blk, C), row_map))
         operands.append(rmask)
+        if census:
+            in_specs.append(pl.BlockSpec((blk, C), row_map))
+            operands.append(census_ok)
         out_specs = [pl.BlockSpec((W, blk, C), acc_map),
                      pl.BlockSpec((W, blk, C), acc_map)]
         out_shape = [jax.ShapeDtypeStruct((W, R, C), jnp.int32),
                      jax.ShapeDtypeStruct((W, R, C), jnp.int32)]
+        if census:
+            # one (8, 128) partial tile per row block, written at the
+            # final slot from the resident accumulator (d-constant map)
+            cen_map = lambda t, d, *_: (t, 0, 0)
+            out_specs += [pl.BlockSpec((1, 8, C), cen_map),
+                          pl.BlockSpec((1, 8, C), cen_map)]
+            out_shape += [jax.ShapeDtypeStruct((T, 8, C), jnp.int32),
+                          jax.ShapeDtypeStruct((T, 8, C), jnp.int32)]
     else:
         out_specs = pl.BlockSpec((W, blk, C), acc_map)
         out_shape = jax.ShapeDtypeStruct((W, R, C), jnp.int32)
@@ -306,7 +392,8 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
     )
     out = pl.pallas_call(
         functools.partial(_pass_kernel, pull, W, fanout, fused,
-                          acc_init is not None, finalize, faulty, n_pref),
+                          acc_init is not None, finalize, census, faulty,
+                          n_pref),
         grid_spec=grid_spec,
         out_shape=out_shape,
         interpret=interpret,
@@ -557,6 +644,48 @@ def liveness_pass(y_alive: jax.Array, colidx: jax.Array,
         ],
         interpret=interpret,
     )(*prefetch, y_alive, colidx, strikes, gate)
+
+
+def stream_plan(rolls, t_blocks: int, ty_blocks: int | None = None,
+                ytab=None, n_slots: int | None = None) -> dict:
+    """Replay one (T row-blocks x D slots) pass's DMA-descriptor
+    sequence on the host — the traffic model's ground truth for what
+    the grid actually streams, derived from the SAME index-map rules
+    the BlockSpecs above encode (y: ``(t + rolls[d]) % Ty``, or
+    ``ytab[d, t]`` on block-perm overlays; per-slot tables: ``(d, t)``;
+    d-constant planes: ``(t,)``).
+
+    Dedup rule: a block whose index is unchanged from the previous grid
+    step is served from the resident VMEM buffer instead of re-DMA'd
+    (the pallas revisiting/pipelining contract the roll-group layout
+    exploits); the replay counts only index CHANGES, exactly like the
+    pipeline's descriptor stream.  Returned block-fetch counts:
+
+      ``y``       sender-plane (and, fused, src_ok) fetches after dedup
+      ``y_naive`` T * D — the no-reuse upper bound (feeds the model's
+                  calibrated partial-reuse interpolation)
+      ``tab``     per-(row-block, slot) int8 tables (colidx): T * D
+      ``row``     d-constant per-row-block planes (gate/rmask/...): T
+
+    ``n_slots`` restricts the replay to the first n slots (the
+    pull-window grid); ``ty_blocks`` covers the sharded case where the
+    y planes span more blocks than the local output grid."""
+    rolls = np.asarray(rolls)
+    D = len(rolls) if n_slots is None else n_slots
+    T = t_blocks
+    Ty = t_blocks if ty_blocks is None else ty_blocks
+    yt = None if ytab is None else np.asarray(ytab)
+    fetches = 0
+    last = None
+    for t in range(T):
+        for d in range(D):
+            i = (int(yt[d, t]) if yt is not None
+                 else int((t + rolls[d]) % Ty))
+            if i != last:
+                fetches += 1
+                last = i
+    return {"y": fetches, "y_naive": T * D, "tab": T * D, "row": T,
+            "grid": (T, D)}
 
 
 def neighbor_ids(perm, rolls, subrolls, colidx, *, rowblk: int = 512):

@@ -141,9 +141,12 @@ def test_block_perm_sharded_bitwise(devices8):
 
 def test_block_perm_traffic_model_drops_prep():
     """The model's accounting: fused kills the 3W prep term and adds an
-    src_ok stream per distinct roll."""
+    src_ok stream per distinct roll.  Built with ``reuse_leak=0``
+    (perfect reuse), where the calibrated model reduces to the exact
+    DMA-descriptor closed form; the calibrated default only ever
+    charges MORE (asserted at the end)."""
     kw = dict(seed=0, n=1 << 18, n_slots=16, degree_law="powerlaw",
-              roll_groups=4)
+              roll_groups=4, reuse_leak=0.0)
     legacy = AlignedSimulator(
         topo=build_aligned(**kw), n_msgs=256, mode="pushpull",
         interpret=True)
@@ -151,24 +154,40 @@ def test_block_perm_traffic_model_drops_prep():
         topo=build_aligned(block_perm=True, **kw), n_msgs=256,
         mode="pushpull", interpret=True)
     assert fused.hbm_bytes_per_round() < legacy.hbm_bytes_per_round()
+    from p2p_gossipprotocol_tpu.ops.aligned_kernel import stream_plan
+
     R, LANES = legacy.topo.rows, 128
     W = legacy.n_words
     plane = R * LANES * 4
+    blk = legacy.topo.rowblk
+    wb = blk * LANES * 4                 # one y block
+    T = R // blk
 
-    def streams(sim):
-        rolls = np.asarray(sim.topo.rolls)
-        return int(1 + (np.diff(rolls) != 0).sum())
+    def fetches(sim):
+        """DMA-descriptor y-block fetches per pass (the grid replay —
+        dedups across row-block boundaries too, which the old
+        1 + diff(rolls) closed form overcounted)."""
+        ytab = (None if sim.topo.ytab is None
+                else np.asarray(sim.topo.ytab))
+        return stream_plan(np.asarray(sim.topo.rolls), T,
+                           ytab=ytab)["y"]
 
-    # per pushpull round (2 passes): the 3W prep planes are removed and
-    # one src_ok plane per distinct roll is added; the y term uses each
+    # per pushpull round (2 passes): the 3W prep planes are removed, one
+    # src_ok block rides each fused y fetch, and the y term uses each
     # topology's own roll draw (block_perm shifts the RNG stream, so the
-    # two topos can land different distinct-roll counts)
+    # two topos can land different fetch counts)
     expect_delta = 2 * (3 * W * plane                      # prep removed
-                        - streams(fused) * plane           # src_ok added
-                        + (streams(legacy) - streams(fused))
-                        * W * plane)                       # y-roll diff
+                        - fetches(fused) * wb              # src_ok added
+                        + (fetches(legacy) - fetches(fused))
+                        * W * wb)                          # y-roll diff
     assert (legacy.hbm_bytes_per_round()
             - fused.hbm_bytes_per_round()) == expect_delta
+    # the calibrated default (partial reuse, Y_REUSE_LEAK) charges more
+    # bytes than the perfect-reuse floor, never fewer
+    cal = AlignedSimulator(
+        topo=build_aligned(**{**kw, "reuse_leak": 0.43}), n_msgs=256,
+        mode="pushpull", interpret=True)
+    assert cal.hbm_bytes_per_round() > legacy.hbm_bytes_per_round()
 
 
 def test_block_perm_from_config(tmp_path):

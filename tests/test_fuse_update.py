@@ -78,6 +78,54 @@ def test_fuse_update_sharded_parity(devices8):
     _assert_bitwise(base, sh2, "2d-mesh")
 
 
+def test_census_fanout_parity():
+    """Round-6 acceptance: the in-kernel census must stay bitwise-equal
+    to the jnp census under bounded-fanout rumor mongering too (the
+    shift plane changes the accumulator the census folds)."""
+    ra = _mk(False, "pushpull", False, fanout=3).run(6)
+    rb = _mk(False, "pushpull", True, fanout=3).run(6)
+    _assert_bitwise(ra, rb, "fanout")
+    rc = _mk(True, "pushpull", False, fanout=3).run(6)
+    rd = _mk(True, "pushpull", True, fanout=3).run(6)
+    _assert_bitwise(rc, rd, "fanout-fused-overlay")
+
+
+def test_kernel_census_matches_jnp_census_directly():
+    """One finalize pass with census outputs: the per-block partial
+    tiles must reproduce popcount(new) and popcount(seen' & ok &
+    hmask) EXACTLY — the kernel census and the jnp census are the same
+    integers, not statistically close ones."""
+    from p2p_gossipprotocol_tpu.ops.aligned_kernel import gossip_pass
+
+    rng = np.random.default_rng(11)
+    W, R, C, D = 3, 32, 128, 5
+    ii = np.iinfo(np.int32)
+    y = rng.integers(ii.min, ii.max, size=(W, R, C), dtype=np.int32)
+    seen = rng.integers(ii.min, ii.max, size=(W, R, C), dtype=np.int32)
+    colidx = rng.integers(0, C, size=(D, R, C), dtype=np.int8)
+    gate = rng.integers(1, D + 1, size=(R, C), dtype=np.int8)
+    rolls = rng.integers(0, 4, size=D, dtype=np.int32)
+    subrolls = rng.integers(0, 8, size=D, dtype=np.int32)
+    rmask = np.where(rng.random((R, C)) < 0.9, -1, 0).astype(np.int32)
+    ok = (rmask & np.where(rng.random((R, C)) < 0.9, -1, 0)).astype(
+        np.int32)
+    hmask = np.array([-1, 0x0000FFFF, 0x7F], np.int32)
+    new, seen2, dpb, cpb = gossip_pass(
+        jnp.asarray(y), jnp.asarray(colidx), jnp.asarray(gate),
+        jnp.asarray(rolls), jnp.asarray(subrolls),
+        seen=jnp.asarray(seen), rmask=jnp.asarray(rmask),
+        census_ok=jnp.asarray(ok), census_hmask=jnp.asarray(hmask),
+        rowblk=8, interpret=True)
+    deliv = int(np.asarray(dpb).sum())
+    cov = int(np.asarray(cpb).sum())
+    expect_deliv = int(np.unpackbits(
+        np.asarray(new).view(np.uint8)).sum())
+    masked = np.asarray(seen2) & ok[None] & hmask[:, None, None]
+    expect_cov = int(np.unpackbits(masked.view(np.uint8)).sum())
+    assert deliv == expect_deliv
+    assert cov == expect_cov
+
+
 def test_fuse_update_model_bytes_drop():
     """The traffic model charges the fused update less than the XLA
     elementwise update in every mode (the whole point of the fusion)."""
