@@ -72,17 +72,27 @@ maybe_refresh_bench() {
 }
 
 # name | command | timeout.  Exit 0 = done (now or previously); exit 1 =
-# this attempt failed (caller decides whether it counts).
+# this attempt failed (caller decides whether it counts); exit 2 = the
+# step was PREEMPTED but left a salvage checkpoint (the CLI's exit-75
+# resumable contract, utils/checkpoint.py) — the next window re-invokes
+# it with --resume instead of restarting from round 0, and the attempt
+# is never charged (preemption is the window's fault, not the step's).
 run_step() {
   local name=$1 cmd=$2 tmo=$3 rc=0
   settled "$name" && return 0
   say "step $name starting"
   if timeout -k 30 "$tmo" bash -c "$cmd" >>"$LOG" 2>&1; then
     touch "$STAMPS/$name.done"
+    rm -f "$STAMPS/$name.resume"
     say "step $name DONE"
     return 0
   else
     rc=$?
+  fi
+  if [ "$rc" -eq 75 ]; then
+    touch "$STAMPS/$name.resume"
+    say "step $name preempted with a salvage checkpoint (rc=75) — will resume next window"
+    return 2
   fi
   say "step $name failed (rc=$rc)"
   return 1
@@ -99,10 +109,15 @@ record_fail() {
   fi
 }
 
-STEP_NAMES="bench mosaic_smoke measure_round4 measure_round5 measure_round6 baselines"
+STEP_NAMES="bench mosaic_smoke measure_round4 measure_round5 measure_round6 baselines longrun"
 # Headline first: a short tunnel window must yield the most important
 # artifact.  bench keeps its file contract (ONE parsed line) and only
-# stamps when the line really came from the chip.
+# stamps when the line really came from the chip.  longrun is the
+# elastic-checkpoint rehearsal: a checkpointed 1M-peer run that rides
+# the exit-75 resume contract across tunnel windows — a preempted
+# window leaves a salvage checkpoint and the next window CONTINUES it
+# (--resume via the .resume stamp) instead of restarting from round 0.
+LONGRUN_CK=benchmarks/results/longrun_ck
 step_cmd() {
   case $1 in
     bench) echo "python bench.py >benchmarks/results/bench_r5_tpu.json \
@@ -117,6 +132,16 @@ PY" ;;
     measure_round5) echo "python benchmarks/measure_round5.py" ;;
     measure_round6) echo "python benchmarks/measure_round6.py" ;;
     baselines)      echo "python benchmarks/run_baselines.py" ;;
+    longrun)
+      # resume whenever a committed checkpoint exists — covers both the
+      # clean rc-75 salvage AND a window that died mid-run (timeout
+      # kill), so no TPU window ever repeats completed rounds
+      local resume=""
+      [ -e "$LONGRUN_CK/manifest.json" ] && resume="--resume"
+      echo "python -m p2p_gossipprotocol_tpu.cli network.txt --quiet \
+        --n-peers 1048576 --engine aligned --mode pushpull --rounds 64 \
+        --checkpoint-every 8 --checkpoint-dir $LONGRUN_CK $resume \
+        --metrics-jsonl benchmarks/results/longrun_metrics.jsonl" ;;
   esac
 }
 step_tmo() {
@@ -125,6 +150,7 @@ step_tmo() {
     measure_round4) echo 4800 ;; measure_round5) echo 3600 ;;
     measure_round6) echo 3600 ;;
     baselines) echo 4800 ;;
+    longrun) echo 1800 ;;
   esac
 }
 
@@ -135,8 +161,13 @@ while true; do
     maybe_refresh_bench
     for name in $STEP_NAMES; do
       settled "$name" && continue
-      if ! run_step "$name" "$(step_cmd "$name")" "$(step_tmo "$name")"
-      then
+      run_step "$name" "$(step_cmd "$name")" "$(step_tmo "$name")"
+      rc=$?
+      if [ "$rc" -eq 2 ]; then
+        # preempted-but-resumable (exit 75): never charged — the next
+        # window re-invokes with --resume and continues the run
+        continue
+      elif [ "$rc" -ne 0 ]; then
         # Charge the attempt only if the tunnel is STILL up (the
         # failure was the step's own); a dead tunnel goes straight
         # back to probe duty without burning the budget or the

@@ -328,6 +328,47 @@ def build_aligned(seed: int, n: int, n_slots: int = 16,
     )
 
 
+#: array leaves of AlignedTopology, in canonical-checkpoint order
+#: (``ytab`` is optional and rides separately — see canonical_topo).
+ALIGNED_TOPO_LEAVES = ("perm", "rolls", "subrolls", "colidx", "deg",
+                       "valid_w")
+
+
+def canonical_topo(topo: AlignedTopology) -> tuple[dict, dict]:
+    """(arrays, meta) — the layout-free host form of an aligned overlay.
+    ``arrays`` maps leaf name -> numpy (device_get gathers sharded
+    leaves to their global view); ``meta`` records the static fields a
+    reader needs to rebuild the identical AlignedTopology.  The
+    canonicalize half of the elastic-checkpoint contract
+    (utils/checkpoint.py): any aligned engine whose layout divides the
+    recorded ``rowblk`` grid can restore and continue bitwise."""
+    arrays = {k: np.asarray(jax.device_get(getattr(topo, k)))
+              for k in ALIGNED_TOPO_LEAVES}
+    if topo.ytab is not None:
+        arrays["ytab"] = np.asarray(jax.device_get(topo.ytab))
+    meta = {"n_peers": topo.n_peers, "n_slots": topo.n_slots,
+            "rowblk": topo.rowblk, "roll_groups": topo.roll_groups,
+            "reuse_leak": topo.reuse_leak}
+    return arrays, meta
+
+
+def topo_from_canonical(arrays: dict, meta: dict) -> AlignedTopology:
+    """Rebuild an AlignedTopology from :func:`canonical_topo` output.
+    The checkpoint's statics WIN over whatever the reader's config
+    would have built — ``rowblk`` shapes the block-roll neighbor map,
+    so continuing bitwise requires the writer's grid, not the
+    reader's."""
+    ytab = arrays.get("ytab")
+    return AlignedTopology(
+        **{k: jnp.asarray(arrays[k]) for k in ALIGNED_TOPO_LEAVES},
+        ytab=None if ytab is None else jnp.asarray(ytab),
+        n_peers=int(meta["n_peers"]), n_slots=int(meta["n_slots"]),
+        rowblk=int(meta["rowblk"]),
+        roll_groups=(None if meta.get("roll_groups") is None
+                     else int(meta["roll_groups"])),
+        reuse_leak=float(meta.get("reuse_leak", Y_REUSE_LEAK)))
+
+
 @struct.dataclass
 class AlignedState:
     """Bit-packed network state.  Maps to the edge engine's GossipState

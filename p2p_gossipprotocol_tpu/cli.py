@@ -92,7 +92,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resume", action="store_true",
                    help="jax mode: continue from the checkpoint in "
                         "--checkpoint-dir; the completed run's summary "
-                        "is identical to an uninterrupted one")
+                        "is identical to an uninterrupted one.  The "
+                        "checkpoint is canonical/layout-free: resuming "
+                        "on a different --engine layout (mesh-devices/"
+                        "msg-shards, or a single device) continues "
+                        "bitwise-identically.  A run interrupted by "
+                        "SIGINT/SIGTERM salvages a checkpoint and exits "
+                        "75 (resumable)")
     p.add_argument("--metrics-jsonl", default=None, metavar="PATH",
                    help="write per-round metrics as JSONL")
     p.add_argument("--profile-dir", default=None, metavar="DIR",
@@ -101,17 +107,36 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _run_sim(sim, rounds, args):
+def _run_sim(sim, rounds, args, cfg, engine, stop):
     """sim.run(rounds), optionally through the checkpoint runner (the
     CLI face of utils.checkpoint.run_with_checkpoints: kill a run, pass
-    --resume, get the summary an uninterrupted run would print)."""
+    --resume, get the summary an uninterrupted run would print).
+
+    Under the runner, SIGINT/SIGTERM flip the ``stop`` flag instead of
+    killing the process: the in-flight chunk completes, a SALVAGE
+    checkpoint persists at that round boundary, and main exits with the
+    resumable code (utils.checkpoint.EX_RESUMABLE, 75) that
+    benchmarks/tpu_watchdog.sh turns into a --resume re-invocation —
+    the TPU-preemption survival path."""
     if args.checkpoint_every > 0 or args.resume:
+        from p2p_gossipprotocol_tpu.engines import config_keys
         from p2p_gossipprotocol_tpu.utils.checkpoint import \
             run_with_checkpoints
 
+        def handler(signum, frame):
+            print("\nReceived signal to terminate — salvage checkpoint "
+                  "at the next round boundary, then exiting resumable "
+                  "(code 75; re-run with --resume).", file=sys.stderr)
+            stop["flag"] = True
+
+        signal.signal(signal.SIGINT, handler)
+        signal.signal(signal.SIGTERM, handler)
         return run_with_checkpoints(
             sim, rounds, every=args.checkpoint_every or rounds,
-            directory=args.checkpoint_dir, resume=args.resume)
+            directory=args.checkpoint_dir, resume=args.resume,
+            should_stop=lambda: stop["flag"],
+            config_keys=config_keys(cfg, n_peers=args.n_peers),
+            engine=engine)
     return sim.run(rounds)
 
 
@@ -158,8 +183,25 @@ def _run_jax(cfg: NetworkConfig, args) -> int:
             print(f"[jax] simulating {n} peers, "
                   f"{sim.n_msgs} messages, mode={sim.mode}, "
                   f"{int(sim.topo.n_edges())} edges, engine={engine}")
-    with metrics_lib.profile(args.profile_dir):
-        res = _run_sim(sim, rounds, args)
+    from p2p_gossipprotocol_tpu.utils.checkpoint import (CheckpointError,
+                                                         EX_RESUMABLE)
+
+    stop = {"flag": False}
+    try:
+        with metrics_lib.profile(args.profile_dir):
+            res = _run_sim(sim, rounds, args, cfg, engine, stop)
+    except CheckpointError as e:
+        # named, actionable (fingerprint drift with the offending keys,
+        # corrupt generations, impossible migration target) — never an
+        # orbax traceback
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    if res is None:
+        # interrupted before the first chunk completed: nothing salvaged
+        print("Error: interrupted before the first checkpoint chunk "
+              "completed — nothing salvaged (resume an earlier "
+              "checkpoint if one exists)", file=sys.stderr)
+        return EX_RESUMABLE if args.resume else 1
     graph_backend = (cfg.graph_backend if engine.startswith("edges")
                      else None)
     if cfg.mode == "sir":
@@ -170,6 +212,11 @@ def _run_jax(cfg: NetworkConfig, args) -> int:
         _report(res, sim, n_peers=n, engine=engine, args=args,
                 metrics_lib=metrics_lib, clamps=clamps or None,
                 graph_backend=graph_backend)
+    done = len(res.infected if cfg.mode == "sir" else res.coverage)
+    if stop["flag"] and done < rounds:
+        print(f"[checkpoint] salvage checkpoint covers {done}/{rounds} "
+              "rounds — exiting resumable (75)", file=sys.stderr)
+        return EX_RESUMABLE
     return 0
 
 
@@ -342,6 +389,14 @@ def main(argv: list[str] | None = None) -> int:
               "features (the socket runtime is one real peer process)",
               file=sys.stderr)
         return 1
+    # checkpoint flags fall back to the config keys (same rule as the
+    # mesh flags above), so a config file alone gets elastic resume
+    if args.checkpoint_every == 0 and cfg.checkpoint_every > 0:
+        args.checkpoint_every = cfg.checkpoint_every
+    if args.checkpoint_dir is None and cfg.checkpoint_dir:
+        args.checkpoint_dir = cfg.checkpoint_dir
+    if not args.resume and cfg.checkpoint_resume:
+        args.resume = True
     if (args.checkpoint_every > 0 or args.resume) \
             and not args.checkpoint_dir:
         print("Error: --checkpoint-every/--resume need --checkpoint-dir",

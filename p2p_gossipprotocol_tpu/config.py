@@ -141,6 +141,16 @@ _SIM_INT_KEYS = {
     # of two <= 128) and the plan's own PRNG seed.
     "fault_partition_groups": "fault_partition_groups",
     "fault_seed": "fault_seed",
+    # jax backend: checkpoint the full simulation state every N rounds
+    # (0 = off) into checkpoint_dir — the config-file twins of the
+    # CLI's --checkpoint-every/--resume, so a deployment (and the
+    # wrapper.Peer facade) gets elastic kill-and-resume without CLI
+    # flags.  checkpoint_resume=1 continues from the directory's
+    # checkpoint; the resumed run may use a DIFFERENT engine layout
+    # (mesh_devices/msg_shards) than the writer — the checkpoint is
+    # canonical (utils/checkpoint.py).
+    "checkpoint_every": "checkpoint_every",
+    "checkpoint_resume": "checkpoint_resume",
 }
 _SIM_FLOAT_KEYS = {
     "er_p": "er_p",
@@ -174,6 +184,9 @@ _SIM_STR_KEYS = {
     "fault_partition": "fault_partition",
     "fault_crash": "fault_crash",
     "fault_recover": "fault_recover",
+    # jax backend: where checkpoints live (required when
+    # checkpoint_every/checkpoint_resume are set).
+    "checkpoint_dir": "checkpoint_dir",
 }
 
 
@@ -245,6 +258,10 @@ class NetworkConfig:
         self.fault_crash = ""            # "round:frac[+round:frac...]"
         self.fault_recover = ""
         self.fault_seed = 0
+        # Elastic checkpointing (utils/checkpoint.py; jax backend)
+        self.checkpoint_every = 0        # rounds per checkpoint; 0 = off
+        self.checkpoint_dir = ""
+        self.checkpoint_resume = 0       # 1 = continue from checkpoint_dir
         self._load_config()
         self._validate_config()
 
@@ -362,9 +379,14 @@ class NetworkConfig:
         for k in ("n_peers", "n_messages", "avg_degree", "ba_m", "fanout",
                   "roll_groups", "fuse_update", "pull_window",
                   "rounds", "prng_seed", "anti_entropy_interval",
-                  "message_stagger", "mesh_devices", "msg_shards"):
+                  "message_stagger", "mesh_devices", "msg_shards",
+                  "checkpoint_every", "checkpoint_resume"):
             if getattr(self, k) < 0:
                 raise ConfigError(f"{k} must be non-negative")
+        if (self.checkpoint_every > 0 or self.checkpoint_resume) \
+                and not self.checkpoint_dir:
+            raise ConfigError(
+                "checkpoint_every/checkpoint_resume need checkpoint_dir")
         if self.block_perm < -1:
             # -1 = auto-select (the default); 0/1 force off/on
             raise ConfigError("block_perm must be -1 (auto), 0, or 1")

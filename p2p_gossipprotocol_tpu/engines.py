@@ -104,6 +104,56 @@ def probe_backend() -> bool:
     return not ok
 
 
+def config_keys(cfg, n_peers: int | None = None) -> dict:
+    """The trajectory-determining config identity — the dict behind a
+    checkpoint's config fingerprint (utils.checkpoint.config_fingerprint),
+    built HERE because engines.build_simulator is the one table both the
+    CLI and wrapper.Peer share, so the two surfaces fingerprint runs
+    identically.
+
+    Deliberately EXCLUDED: the device-layout keys (``mesh_devices``,
+    ``msg_shards``) — migrating a checkpoint across layouts is the
+    elastic-resume contract, and the bitwise sharded-vs-unsharded parity
+    tests (docs/PARITY.md) guarantee the trajectory doesn't depend on
+    them — and ``fuse_update``, whose in-kernel update/census path is
+    bitwise-parity-tested against the XLA path (test_fuse_update.py).
+    Everything that picks the overlay, the model, the randomness chain,
+    or the fault schedule is included."""
+    return {
+        "n_peers": n_peers or cfg.n_peers or len(cfg.seed_nodes),
+        "n_messages": cfg.n_messages or cfg.max_message_count,
+        "engine": cfg.engine,
+        "mode": cfg.mode,
+        "graph": cfg.graph,
+        "graph_backend": cfg.graph_backend,
+        "avg_degree": cfg.avg_degree,
+        "ba_m": cfg.ba_m,
+        "er_p": cfg.er_p,
+        "powerlaw_alpha": cfg.powerlaw_alpha,
+        "fanout": cfg.fanout,
+        "churn_rate": cfg.churn_rate,
+        "byzantine_fraction": cfg.byzantine_fraction,
+        "max_missed_pings": cfg.max_missed_pings,
+        "message_stagger": cfg.message_stagger,
+        "prng_seed": cfg.prng_seed,
+        "ping_interval": cfg.ping_interval_secs,
+        "message_interval": cfg.message_interval_secs,
+        "sir_beta": cfg.sir_beta,
+        "sir_gamma": cfg.sir_gamma,
+        "roll_groups": cfg.roll_groups,
+        "block_perm": cfg.block_perm,
+        "pull_window": cfg.pull_window,
+        "fault_link_drop": cfg.fault_link_drop,
+        "fault_delay": cfg.fault_delay,
+        "fault_byzantine": cfg.fault_byzantine,
+        "fault_partition": cfg.fault_partition,
+        "fault_partition_groups": cfg.fault_partition_groups,
+        "fault_crash": cfg.fault_crash,
+        "fault_recover": cfg.fault_recover,
+        "fault_seed": cfg.fault_seed,
+    }
+
+
 def build_simulator(cfg, *, n_peers: int | None = None,
                     mesh_devices: int | None = None,
                     msg_shards: int | None = None,

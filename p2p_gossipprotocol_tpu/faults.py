@@ -23,6 +23,14 @@ One declarative :class:`FaultPlan` drives every backend:
   ``transport.socket_transport.FaultyTransport`` refuses a fraction of
   connects — exercising the retry-with-backoff send path.
 
+Checkpoint-safety contract: every engine-side fault draw is keyed on
+``(plan seed, round, global id)`` via :func:`round_key` — never the
+simulation's own PRNG chain — so a crash-/partition-scheduled run that
+is checkpointed and resumed (utils/checkpoint.py, on ANY engine layout)
+replays the remaining fault schedule bit-identically from the restored
+round counter (asserted in tests/test_checkpoint.py's crash-schedule
+resume test).
+
 Fault model granularity (documented, asserted in tests/test_faults.py):
 
 * ``link_drop`` — each DIRECTED link transfer independently fails this

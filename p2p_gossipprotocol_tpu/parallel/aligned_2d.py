@@ -135,7 +135,12 @@ class Aligned2DShardedSimulator:
 
     # ------------------------------------------------------------------
     def init_state(self) -> AlignedState:
-        state = self._inner.init_state()
+        return self.place_state(self._inner.init_state())
+
+    def place_state(self, state: AlignedState) -> AlignedState:
+        """Lay a host-global AlignedState out on the 2-D mesh — the
+        canonical-checkpoint partition hook (message planes shard over
+        the msg axis, rows over the peer axis)."""
         shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s),
             _state_spec(self._liveness),

@@ -130,7 +130,13 @@ class AlignedShardedSimulator:
     def init_state(self) -> AlignedState:
         """Init globally (bitwise-identical for any shard count), then lay
         out on the mesh."""
-        state = self._inner.init_state()
+        return self.place_state(self._inner.init_state())
+
+    def place_state(self, state: AlignedState) -> AlignedState:
+        """Lay a host-global AlignedState out on the mesh — the
+        partition hook canonical-checkpoint restore uses (the state
+        arrays are layout-free; placement is the only per-engine
+        step)."""
         shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s),
             _state_spec(self._liveness),
@@ -307,7 +313,11 @@ class AlignedShardedSIRSimulator:
 
     # ------------------------------------------------------------------
     def init_state(self) -> AlignedSIRState:
-        state = self._inner.init_state()
+        return self.place_state(self._inner.init_state())
+
+    def place_state(self, state: AlignedSIRState) -> AlignedSIRState:
+        """Mesh layout for a host-global AlignedSIRState (the canonical-
+        checkpoint partition hook, like the gossip engine's)."""
         spec = _sir_state_spec().replace(n_peers=state.n_peers)
         shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), spec,

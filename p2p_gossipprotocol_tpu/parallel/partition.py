@@ -120,6 +120,40 @@ def partition_topology(topo: Topology, n_shards: int,
     )
 
 
+def real_slot_mask(stopo: ShardedTopology) -> np.ndarray:
+    """bool[S*E] — True for slots holding a REAL edge (padded tail slots
+    of each shard's block are False).  Derived from the per-shard CSR
+    widths, not from ``edge_mask`` (eviction without rewire clears the
+    mask of a real slot, but the slot still carries meaningful
+    ``dst``/strike state that a canonical checkpoint must round-trip)."""
+    S, E, B = stopo.n_shards, stopo.e_shard, stopo.block
+    rp = np.asarray(stopo.row_ptr).reshape(S, B + 1)
+    counts = rp[:, B]                                  # edges per shard
+    return (np.arange(E)[None, :] < counts[:, None]).reshape(-1)
+
+
+def unpartition_edges(stopo: ShardedTopology, values,
+                      fill=0) -> np.ndarray:
+    """Scatter a per-local-slot array ([S*E], the sharded layout) back to
+    GLOBAL edge order ([e_gcap]) through ``gidx`` — the inverse of the
+    partition slicing, for dst / edge_mask / strikes.  Padded slots are
+    dropped (their gidx of 0 would otherwise clobber global edge 0)."""
+    vals = np.asarray(values).reshape(-1)
+    out = np.full((stopo.e_gcap,), fill, dtype=vals.dtype)
+    real = real_slot_mask(stopo)
+    out[np.asarray(stopo.gidx)[real]] = vals[real]
+    return out
+
+
+def partition_edges(stopo: ShardedTopology, global_values) -> jax.Array:
+    """Gather a GLOBAL per-edge array into the sharded slot layout —
+    the forward of :func:`unpartition_edges` (padded slots get 0)."""
+    g = np.asarray(global_values)
+    local = g[np.asarray(stopo.gidx)]
+    local[~real_slot_mask(stopo)] = 0
+    return jnp.asarray(local)
+
+
 def state_spec() -> GossipState:
     """PartitionSpec tree for a sharded :class:`GossipState` (peer-axis
     leaves sharded; PRNG key and round counter replicated)."""
@@ -130,19 +164,24 @@ def state_spec() -> GossipState:
 
 
 def shard_state(state: GossipState, stopo: ShardedTopology,
-                mesh) -> GossipState:
+                mesh, edge_strikes=None) -> GossipState:
     """Pad a globally-initialized state to ``n_pad`` peers and lay it out
     on the mesh.  Padding peers are dead (``alive=False``) so they never
     send, receive, or count toward coverage.  ``edge_strikes`` is re-laid
-    out to the per-shard edge capacity (fresh zeros — strikes are
-    transient liveness observations, always zero at init)."""
+    out to the per-shard edge capacity: fresh zeros by default (strikes
+    are transient liveness observations, always zero at init), or — when
+    a GLOBAL-order strike array is passed (canonical checkpoint restore)
+    — gathered into the slot layout via :func:`partition_edges`."""
     pad = stopo.n_pad - state.n_peers
+    strikes = (jnp.zeros(stopo.n_shards * stopo.e_shard, jnp.int32)
+               if edge_strikes is None
+               else partition_edges(stopo, edge_strikes))
     padded = state.replace(
         seen=jnp.pad(state.seen, ((0, pad), (0, 0))),
         frontier=jnp.pad(state.frontier, ((0, pad), (0, 0))),
         alive=jnp.pad(state.alive, (0, pad)),
         byzantine=jnp.pad(state.byzantine, (0, pad)),
-        edge_strikes=jnp.zeros(stopo.n_shards * stopo.e_shard, jnp.int32),
+        edge_strikes=strikes,
     )
     shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), state_spec())
     return jax.device_put(padded, shardings)

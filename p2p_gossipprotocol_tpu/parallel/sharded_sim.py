@@ -132,6 +132,17 @@ class ShardedSimulator:
             stagger=self.message_stagger)
         return shard_state(global_state, self.stopo, self.mesh)
 
+    def place_state(self, state: GossipState,
+                    edge_strikes=None) -> GossipState:
+        """Partition hook for canonical-checkpoint restore: pad a
+        host-GLOBAL GossipState onto this mesh, with ``edge_strikes``
+        (global edge order, [e_gcap]) gathered into the per-shard slot
+        layout.  ``state.edge_strikes`` itself is ignored — the global
+        strike array must come through ``edge_strikes`` because the
+        field's meaning is layout-dependent."""
+        return shard_state(state, self.stopo, self.mesh,
+                           edge_strikes=edge_strikes)
+
     def _message_plan(self) -> jax.Array:
         """Global per-column source peers — the shared derivation
         (state.message_plan), so the sharded engine injects staggered

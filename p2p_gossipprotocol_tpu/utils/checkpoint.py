@@ -1,18 +1,83 @@
-"""Checkpoint / resume — a subsystem the reference lacks entirely
+"""Elastic checkpoint / resume — a subsystem the reference lacks entirely
 (SURVEY.md §5: ``messageList``/``connectedPeers``/``peerList`` live only
 in process memory, peer.hpp:48-62, seed.hpp:14; kill a peer and its state
 is gone, which is exactly the failure the README demo celebrates).
 
 Here the whole simulation is a pytree — gossip state (seen/frontier
 words or bool matrices, alive mask, PRNG chain, round counter) plus the
-mutable topology (rewired ``dst``/``edge_mask``) — so mid-simulation
-checkpointing is one orbax save, and resume continues bitwise-identically
-(tested in tests/test_checkpoint.py).
+mutable topology (rewired ``dst``/``edge_mask`` or lane tables) — and a
+checkpoint is a **canonical, self-describing, layout-free artifact**:
+
+* :func:`to_canonical` gathers/unpermutes the device state + topology
+  into host-global numpy form (sharded leaves device_get to their
+  global view; the edges-sharded slot layout scatters back to global
+  edge order through ``gidx``), so the artifact carries NO trace of the
+  mesh that wrote it;
+* :func:`from_canonical` rebinds any engine of the same family to the
+  artifact — a run checkpointed on ``aligned`` 1-D sharded resumes on
+  ``aligned_2d``, a different ``mesh_devices`` count, or the
+  single-device engine, and the cross-engine bitwise parity contract
+  (docs/PARITY.md) makes the continued trajectory bitwise-equal to an
+  uninterrupted run (tested in tests/test_checkpoint.py);
+* every checkpoint writes a ``manifest.json``: schema version, config
+  fingerprint, the engine/mesh that wrote it, result-class name, and
+  per-leaf CRC32s.  Restore verifies all of it and fails with a NAMED
+  error (fingerprint mismatch listing the drifted keys, truncated
+  sidecar, torn ``state_<N>`` dir, CRC fail naming the bad leaf)
+  instead of an opaque orbax shape error — and a corrupt latest
+  checkpoint falls back to the previous intact one when present.
+
+Exit-code contract: a run interrupted by SIGINT/SIGTERM under the
+checkpoint runner persists a salvage checkpoint at the next chunk
+boundary and the CLI exits :data:`EX_RESUMABLE` (75, EX_TEMPFAIL) —
+``benchmarks/tpu_watchdog.sh`` re-invokes with ``--resume`` on that
+code instead of restarting from round 0.
 """
 
 from __future__ import annotations
 
+import json
 import os
+
+#: CLI exit code for "interrupted but a salvage checkpoint landed —
+#: re-invoke with --resume" (EX_TEMPFAIL; consumed by tpu_watchdog.sh).
+EX_RESUMABLE = 75
+
+#: manifest schema version.  tests/test_checkpoint.py pins the exact
+#: field set of this schema — ADDING or renaming fields requires a bump
+#: here plus a reader that still accepts every older version, so future
+#: fields can't silently break old checkpoints.
+SCHEMA_VERSION = 1
+
+#: checkpoint generations retained on disk (current + fallback).  The
+#: corruption fallback needs the previous intact state_<N>/history pair
+#: to exist; older generations are pruned.
+KEEP_CHECKPOINTS = 2
+
+
+class CheckpointError(ValueError):
+    """Base of every named checkpoint failure (a ValueError so existing
+    CLI/facade error paths surface it cleanly)."""
+
+
+class FingerprintMismatch(CheckpointError):
+    """The checkpoint was written under a different config identity."""
+
+
+class CorruptCheckpoint(CheckpointError):
+    """No intact checkpoint generation survives verification."""
+
+
+def config_fingerprint(keys: dict) -> str:
+    """Stable short fingerprint of the trajectory-determining config
+    identity (engines.config_keys builds the dict for both the CLI and
+    wrapper.Peer).  Layout keys (mesh_devices/msg_shards) are excluded
+    there — changing the device layout is exactly the migration this
+    module supports."""
+    import hashlib
+
+    blob = json.dumps(keys, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
 
 def save(path: str, tree) -> None:
@@ -48,16 +113,286 @@ def running_topo(sim):
     return getattr(sim, "stopo", sim.topo)
 
 
+# ----------------------------------------------------------------------
+# Canonical (layout-free) form.
+#
+# Family = which artifact a checkpoint is; every engine of a family can
+# write AND read it.  Cross-family migration (edges <-> aligned) is
+# impossible by construction — the state encodings differ — and fails
+# with a named error.
+
+_FAMILIES = {
+    "Simulator": "edges",
+    "ShardedSimulator": "edges",
+    "SIRSimulator": "edges-sir",
+    "AlignedSimulator": "aligned",
+    "AlignedShardedSimulator": "aligned",
+    "Aligned2DShardedSimulator": "aligned",
+    "AlignedSIRSimulator": "aligned-sir",
+    "AlignedShardedSIRSimulator": "aligned-sir",
+}
+
+#: RNG-schedule identity.  Every aligned engine shares ONE round
+#: implementation (aligned.aligned_round) with per-global-row draws, so
+#: any aligned layout continues any aligned checkpoint bitwise.  The
+#: edges pair is different code with different key schedules: the exact
+#: Simulator and the sharded engine are statistically equivalent but
+#: NOT bitwise-interchangeable mid-trajectory (only the mesh SIZE is
+#: free within ShardedSimulator) — a cross-schedule resume is refused
+#: by name instead of silently continuing a different (valid-looking)
+#: trajectory.
+_SCHEDULES = {
+    "Simulator": "edges-exact",
+    "ShardedSimulator": "edges-sharded",
+    "SIRSimulator": "edges-sir",
+    "AlignedSimulator": "aligned",
+    "AlignedShardedSimulator": "aligned",
+    "Aligned2DShardedSimulator": "aligned",
+    "AlignedSIRSimulator": "aligned-sir",
+    "AlignedShardedSIRSimulator": "aligned-sir",
+}
+
+_ALIGNED_STATE_LEAVES = ("seen_w", "frontier_w", "alive_b", "byz_w",
+                         "key", "round")
+_EDGES_STATE_LEAVES = ("seen", "frontier", "alive", "byzantine",
+                       "edge_strikes", "key", "round")
+_EDGES_TOPO_LEAVES = ("src", "dst", "edge_mask", "row_ptr")
+_SIR_STATE_LEAVES = ("compartment", "alive", "key", "round")
+_ALIGNED_SIR_STATE_LEAVES = ("inf_b", "rec_b", "alive_b", "key", "round")
+
+
+def _family(sim) -> str:
+    name = type(sim).__name__
+    try:
+        return _FAMILIES[name]
+    except KeyError:
+        raise CheckpointError(
+            f"engine {name!r} has no canonical checkpoint form") from None
+
+
+def _np(x):
+    import jax
+    import numpy as np
+
+    return np.asarray(jax.device_get(x))
+
+
+def to_canonical(sim, state, topo=None) -> dict:
+    """Host-canonical snapshot ``{"state": {...}, "topo": {...},
+    "meta": {...}}`` of numpy arrays — the layout-free artifact any
+    engine of the same family can restore (:func:`from_canonical`).
+    Sharded device arrays gather to their global view; the
+    edges-sharded engine's slot-layout leaves (strikes, dst, mask)
+    unpermute to global edge order."""
+    from p2p_gossipprotocol_tpu import aligned as aligned_lib
+
+    fam = _family(sim)
+    topo = running_topo(sim) if topo is None else topo
+    if fam in ("aligned", "aligned-sir"):
+        leaves = (_ALIGNED_STATE_LEAVES if fam == "aligned"
+                  else _ALIGNED_SIR_STATE_LEAVES)
+        sdict = {k: _np(getattr(state, k)) for k in leaves}
+        if fam == "aligned" and state.strikes is not None:
+            sdict["strikes"] = _np(state.strikes)
+        tdict, topo_meta = aligned_lib.canonical_topo(topo)
+    elif fam == "edges":
+        from p2p_gossipprotocol_tpu.parallel.partition import (
+            ShardedTopology, unpartition_edges)
+
+        if isinstance(topo, ShardedTopology):
+            n = topo.n_peers
+            sdict = {k: _np(getattr(state, k))[:n]
+                     for k in ("seen", "frontier", "alive", "byzantine")}
+            sdict["edge_strikes"] = unpartition_edges(topo,
+                                                      state.edge_strikes)
+            sdict["key"] = _np(state.key)
+            sdict["round"] = _np(state.round)
+            base = sim.topo          # host-global statics (src, row_ptr)
+            tdict = {
+                "src": _np(base.src),
+                "dst": unpartition_edges(topo, topo.dst),
+                "edge_mask": unpartition_edges(topo, topo.edge_mask,
+                                               fill=False),
+                "row_ptr": _np(base.row_ptr),
+            }
+            topo_meta = {"n_peers": n}
+        else:
+            sdict = {k: _np(getattr(state, k))
+                     for k in _EDGES_STATE_LEAVES}
+            tdict = {k: _np(getattr(topo, k)) for k in _EDGES_TOPO_LEAVES}
+            topo_meta = {"n_peers": topo.n_peers}
+    else:                                         # edges-sir
+        sdict = {k: _np(getattr(state, k)) for k in _SIR_STATE_LEAVES}
+        tdict = {k: _np(getattr(topo, k)) for k in _EDGES_TOPO_LEAVES}
+        topo_meta = {"n_peers": topo.n_peers}
+    meta = {"family": fam, "schedule": _SCHEDULES[type(sim).__name__],
+            "state_class": type(state).__name__, "topo_meta": topo_meta}
+    return {"state": sdict, "topo": tdict, "meta": meta}
+
+
+def from_canonical(sim, ckpt: dict):
+    """Rebind ``sim`` to a canonical checkpoint: returns
+    ``(sim2, state, topo)`` ready for :func:`run_chunked` —
+    ``sim2`` carries the checkpoint's topology (the writer's statics
+    WIN: ``rowblk`` shapes the aligned neighbor map), ``state`` is laid
+    out for ``sim2``'s mesh, ``topo`` is what ``sim2.run`` accepts.
+    A layout the reader cannot express (rows that don't split over its
+    mesh) raises a named :class:`CheckpointError`, never a shape
+    error deep inside jax."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    fam = _family(sim)
+    want = ckpt["meta"]["family"]
+    if fam != want:
+        raise CheckpointError(
+            f"cross-family restore: checkpoint was written by the "
+            f"{want!r} engine family, reader is {fam!r} — the state "
+            "encodings differ (see docs/ROBUSTNESS.md migration matrix)")
+    sched = _SCHEDULES[type(sim).__name__]
+    want_sched = ckpt["meta"].get("schedule", sched)
+    if sched != want_sched:
+        raise CheckpointError(
+            f"cross-schedule restore: checkpoint was written under the "
+            f"{want_sched!r} RNG schedule, reader runs {sched!r} — the "
+            "two edges engines draw randomness differently, so the "
+            "continued trajectory would silently diverge from an "
+            "uninterrupted run.  Resume with "
+            + ("--mesh-devices >= 2 (the sharded engine)"
+               if want_sched == "edges-sharded"
+               else "--mesh-devices 0 (the single-device engine)")
+            + ", or migrate on the aligned engine family, whose layouts "
+            "all share one schedule (docs/ROBUSTNESS.md)")
+    sdict, tdict = ckpt["state"], ckpt["topo"]
+    topo_meta = ckpt["meta"]["topo_meta"]
+
+    if fam in ("aligned", "aligned-sir"):
+        from p2p_gossipprotocol_tpu import aligned as aligned_lib
+
+        topo = aligned_lib.topo_from_canonical(tdict, topo_meta)
+    else:
+        from p2p_gossipprotocol_tpu.graph import Topology
+
+        topo = Topology(**{k: jnp.asarray(tdict[k])
+                           for k in _EDGES_TOPO_LEAVES},
+                        n_peers=int(topo_meta["n_peers"]))
+    try:
+        sim2 = dataclasses.replace(sim, topo=topo)
+    except ValueError as e:
+        raise CheckpointError(
+            f"checkpoint layout cannot be placed on this engine: {e} — "
+            "see the migration matrix in docs/ROBUSTNESS.md (resume on "
+            "a mesh whose shard count divides the writer's row grid, or "
+            "on a single device)") from e
+
+    if fam == "aligned":
+        from p2p_gossipprotocol_tpu.aligned import AlignedState
+
+        state = AlignedState(
+            **{k: jnp.asarray(sdict[k]) for k in _ALIGNED_STATE_LEAVES},
+            strikes=(jnp.asarray(sdict["strikes"])
+                     if "strikes" in sdict else None))
+    elif fam == "aligned-sir":
+        from p2p_gossipprotocol_tpu.aligned_sir import AlignedSIRState
+
+        state = AlignedSIRState(
+            **{k: jnp.asarray(sdict[k])
+               for k in _ALIGNED_SIR_STATE_LEAVES},
+            n_peers=int(topo_meta["n_peers"]))
+    elif fam == "edges":
+        from p2p_gossipprotocol_tpu.state import GossipState
+
+        state = GossipState(**{k: jnp.asarray(sdict[k])
+                               for k in _EDGES_STATE_LEAVES})
+    else:
+        from p2p_gossipprotocol_tpu.state import SIRState
+
+        state = SIRState(**{k: jnp.asarray(sdict[k])
+                            for k in _SIR_STATE_LEAVES})
+
+    if hasattr(sim2, "place_state"):
+        if fam == "edges":
+            # the global strike array partitions through gidx — the
+            # state field's layout is mesh-dependent
+            state = sim2.place_state(
+                state, edge_strikes=sdict["edge_strikes"])
+        else:
+            state = sim2.place_state(state)
+    run_topo = running_topo(sim2)
+    return sim2, state, run_topo
+
+
+# ----------------------------------------------------------------------
+# Manifest + on-disk layout.
+#
+#   state_<N>/        orbax dir holding the canonical {"state","topo"}
+#   history_<N>.npz   metric history + round/wall counters for round N
+#   manifest.json     schema, fingerprint, engine, per-leaf CRCs, and
+#                     the retained checkpoint generations — atomically
+#                     replaced AFTER the state+history landed, so it is
+#                     the COMMIT point: a kill at any instant leaves the
+#                     manifest pointing at complete generations only.
+
+
+def _crc_entry(arr) -> dict:
+    import zlib
+
+    import numpy as np
+
+    a = np.ascontiguousarray(arr)
+    return {"crc32": zlib.crc32(a.tobytes()) & 0xFFFFFFFF,
+            "dtype": str(a.dtype), "shape": list(a.shape)}
+
+
+def _leaf_crcs(canonical: dict) -> dict:
+    out = {}
+    for group in ("state", "topo"):
+        for name, arr in canonical[group].items():
+            out[f"{group}/{name}"] = _crc_entry(arr)
+    return out
+
+
+def _write_atomic(path: str, data: str) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fp:
+        fp.write(data)
+        fp.flush()
+        os.fsync(fp.fileno())
+    os.replace(tmp, path)
+
+
+def _kill_hook(phase: str, rnd: int) -> None:
+    """Crash-torture seam (tests/test_preemption.py): SIGKILL this
+    process at a named persist phase — ``GOSSIP_CKPT_KILL=phase[:round]``
+    with phase in before|state|history|manifest|prune.  A real
+    preemption can land anywhere; this makes every torn-write window
+    deterministically reachable."""
+    spec = os.environ.get("GOSSIP_CKPT_KILL")
+    if not spec:
+        return
+    p, _, r = spec.partition(":")
+    if p == phase and (not r or int(r) == rnd):
+        import signal
+
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
 def run_chunked(sim, rounds: int, *, every: int, state=None, topo=None,
                 hist=None, wall: float = 0.0, done: int = 0,
-                after_chunk=None, should_stop=None):
+                after_chunk=None, should_stop=None, result_cls=None):
     """Drive ``sim.run`` in ``every``-round chunks — the shared core
     under :func:`run_with_checkpoints` and wrapper.Peer's jax thread.
 
     Result-type agnostic: works with every engine exposing the
     run()/init_state() surface (edges, aligned, 1-D/2-D sharded, both
     SIR engines) — history fields are harvested from the result
-    dataclass, so the two callers cannot drift.
+    dataclass, so the two callers cannot drift.  ``result_cls`` names
+    the result type when no chunk runs this process (resume already at
+    the requested round count); :func:`run_with_checkpoints` passes the
+    class recorded in the checkpoint manifest, and the legacy
+    "coverage"-key inference remains only as the fallback for sidecars
+    written before manifests existed.
 
     Returns ``(result, state, topo, hist, wall, done)`` where ``result``
     is the rebuilt result object covering rounds [0, done), or None if
@@ -69,7 +404,6 @@ def run_chunked(sim, rounds: int, *, every: int, state=None, topo=None,
     import numpy as np
 
     takes_topo = "topo" in inspect.signature(sim.run).parameters
-    result_cls = None
     while done < rounds and not (should_stop() if should_stop else False):
         step = min(every, rounds - done)
         kw = {"topo": topo} if takes_topo else {}
@@ -84,82 +418,290 @@ def run_chunked(sim, rounds: int, *, every: int, state=None, topo=None,
         done += step
         if after_chunk is not None:
             after_chunk(state, topo, hist, wall, done)
+    if hist is None:
+        return None, state, topo, hist, wall, done
     if result_cls is None:
-        if hist is None:
-            return None, state, topo, hist, wall, done
-        # nothing ran this process (resume already at the requested
-        # round count): rebuild the result type from the history shape
+        # nothing ran this process and no manifest named the class:
+        # legacy sidecar — infer the result type from the history shape
         from p2p_gossipprotocol_tpu.sim import SimResult, SIRResult
 
         result_cls = SimResult if "coverage" in hist else SIRResult
-        if topo is None:
-            topo = running_topo(sim)
+    if done > 0 and topo is None:
+        topo = running_topo(sim)
     result = result_cls(state=state, topo=topo, wall_s=wall, **hist)
     return result, state, topo, hist, wall, done
 
 
+def _result_cls_named(name: str):
+    from p2p_gossipprotocol_tpu.sim import SimResult, SIRResult
+
+    return {"SimResult": SimResult, "SIRResult": SIRResult}[name]
+
+
+def _load_generation(directory: str, entry: dict):
+    """Load + verify one manifest generation; returns (canonical_arrays,
+    hist, wall, done).  Raises CorruptCheckpoint with the NAMED defect
+    (missing/torn state dir, truncated sidecar, CRC fail naming the bad
+    leaf) — the caller decides whether a fallback generation exists."""
+    import numpy as np
+
+    done = int(entry["round"])
+    state_dir = os.path.join(directory, f"state_{done}")
+    hist_path = os.path.join(directory, f"history_{done}.npz")
+    if not os.path.isdir(state_dir):
+        raise CorruptCheckpoint(
+            f"state_{done} is missing or torn (not a directory)")
+    try:
+        with np.load(hist_path) as m:
+            hist = {k: m[k] for k in m.files
+                    if k not in ("rounds_done", "wall_s")}
+            wall = float(m["wall_s"])
+    except Exception as e:  # noqa: BLE001 — any unreadable sidecar
+        raise CorruptCheckpoint(
+            f"history_{done}.npz is truncated or unreadable "
+            f"({type(e).__name__}: {e})") from e
+    # shape/dtype target from the manifest, so orbax never guesses
+    target = {"state": {}, "topo": {}}
+    for name, info in entry["leaves"].items():
+        group, leaf = name.split("/", 1)
+        target[group][leaf] = np.zeros(tuple(info["shape"]),
+                                       np.dtype(info["dtype"]))
+    try:
+        canonical = restore(state_dir, target)
+    except Exception as e:  # noqa: BLE001 — torn orbax payload
+        raise CorruptCheckpoint(
+            f"state_{done} failed to restore (torn checkpoint dir: "
+            f"{type(e).__name__})") from e
+    for name, info in entry["leaves"].items():
+        group, leaf = name.split("/", 1)
+        got = _crc_entry(canonical[group][leaf])
+        if got["crc32"] != info["crc32"]:
+            raise CorruptCheckpoint(
+                f"CRC mismatch in state_{done} leaf {name!r} "
+                f"(stored {info['crc32']:#010x}, "
+                f"recomputed {got['crc32']:#010x})")
+    return canonical, hist, wall, done
+
+
+def _fingerprint_check(manifest: dict, config_keys: dict | None) -> None:
+    if config_keys is None or manifest.get("config_keys") is None:
+        return
+    fp_now = config_fingerprint(config_keys)
+    fp_ck = manifest.get("fingerprint")
+    if fp_now == fp_ck:
+        return
+    old = manifest["config_keys"]
+    drift = sorted(set(old) | set(config_keys))
+    diffs = [f"{k}: checkpoint={old.get(k)!r} current={config_keys.get(k)!r}"
+             for k in drift if old.get(k) != config_keys.get(k)]
+    raise FingerprintMismatch(
+        f"checkpoint was written under config fingerprint {fp_ck}, the "
+        f"loaded config fingerprints as {fp_now}; drifted keys: "
+        + ("; ".join(diffs) if diffs else "<none — fingerprint "
+           "algorithm drift>")
+        + " — resume with the original scenario, or point "
+        "--checkpoint-dir at a fresh directory")
+
+
 def run_with_checkpoints(sim, rounds: int, *, every: int, directory: str,
-                         resume: bool = False):
+                         resume: bool = False, should_stop=None,
+                         config_keys: dict | None = None,
+                         engine: str | None = None, on_chunk=None):
     """:func:`run_chunked` with the whole mutable world persisted after
-    each chunk; with ``resume=True``, continue from the checkpoint in
-    ``directory``.
+    each chunk as a canonical artifact; with ``resume=True``, continue
+    from the checkpoint in ``directory`` — on ANY engine of the same
+    family (the elastic-migration contract; see module docstring).
 
-    The device state + topology go through orbax (:func:`save`); the
-    host-side metric history and round/wall counters ride a ``.npz``
-    sidecar, so a resumed run returns the SAME result an uninterrupted
-    ``sim.run(rounds)`` would: bitwise-identical state (the PRNG chain
-    and round counter live in the pytree) and the full metric history —
-    the kill-and-resume contract SURVEY §5 promises.
+    ``should_stop`` is polled between chunks (the CLI's SIGINT/SIGTERM
+    salvage path and wrapper.Peer's stop()): the in-flight chunk
+    completes, its checkpoint persists, and the partial result returns.
+    ``config_keys``/``engine`` stamp the manifest (engines.config_keys
+    builds the former); ``on_chunk(done)`` reports chunk progress.
 
-    Crash-atomic by construction: each chunk saves to a fresh
-    ``state_<round>`` directory, the sidecar is written to a temp file
-    and ``os.replace``d (atomic) only after the state landed, and stale
-    state dirs are pruned last.  A kill at ANY point leaves the sidecar
-    pointing at a complete state directory:
-
-        save state_N | replace sidecar -> N | prune state_{N-every}
-        ^ kill: sidecar -> N-every, intact    ^ kill: both dirs exist
+    Crash-atomic by construction: each generation lands as
+    ``state_<N>`` + ``history_<N>.npz`` BEFORE the manifest is
+    atomically replaced to point at it, and stale generations are
+    pruned last — a kill at ANY instant leaves the manifest naming
+    complete generations only, and restore falls back from a corrupt
+    latest generation to the previous intact one.
     """
+    import sys
+
     import numpy as np
 
     os.makedirs(directory, exist_ok=True)
-    hist_path = os.path.join(directory, "history.npz")
+    manifest_path = os.path.join(directory, "manifest.json")
+    fam = _family(sim)
+    result_cls = _result_cls_named(
+        "SIRResult" if fam.endswith("sir") else "SimResult")
 
     state = topo = hist = None
     done, wall = 0, 0.0
     if resume:
-        if not os.path.exists(hist_path):
-            raise ValueError(
-                f"resume requested but {directory!r} holds no checkpoint "
-                "(no history.npz) — refusing to silently start over")
-        with np.load(hist_path) as m:
-            done = int(m["rounds_done"])
+        legacy = os.path.join(directory, "history.npz")
+        if not os.path.exists(manifest_path):
+            if os.path.exists(legacy):
+                state, topo, hist, wall, done = _resume_legacy(
+                    sim, directory, rounds)
+            else:
+                raise CheckpointError(
+                    f"resume requested but {directory!r} holds no "
+                    "checkpoint (no manifest.json) — refusing to "
+                    "silently start over")
+        else:
+            try:
+                with open(manifest_path) as fp:
+                    manifest = json.load(fp)
+            except Exception as e:  # noqa: BLE001
+                raise CorruptCheckpoint(
+                    f"manifest.json is unreadable ({type(e).__name__}: "
+                    f"{e}) — the checkpoint directory cannot be "
+                    "trusted") from e
+            if int(manifest.get("schema", 0)) > SCHEMA_VERSION:
+                raise CheckpointError(
+                    f"checkpoint manifest schema "
+                    f"{manifest.get('schema')} is newer than this "
+                    f"build's {SCHEMA_VERSION} — upgrade to resume it")
+            _fingerprint_check(manifest, config_keys)
+            entries = sorted(manifest.get("checkpoints", []),
+                             key=lambda e: int(e["round"]), reverse=True)
+            if not entries:
+                raise CorruptCheckpoint(
+                    "manifest.json lists no checkpoint generations")
+            canonical = None
+            failures = []
+            for i, entry in enumerate(entries):
+                try:
+                    canonical, hist, wall, done = _load_generation(
+                        directory, entry)
+                except CorruptCheckpoint as e:
+                    failures.append(str(e))
+                    continue
+                if failures:
+                    print("[checkpoint] latest generation corrupt ("
+                          + "; ".join(failures)
+                          + f") — falling back to intact round {done}",
+                          file=sys.stderr)
+                break
+            if canonical is None:
+                raise CorruptCheckpoint(
+                    f"no intact checkpoint generation in {directory!r}: "
+                    + "; ".join(failures))
             if done > rounds:
-                raise ValueError(
+                raise CheckpointError(
                     f"checkpoint already contains {done} rounds > the "
                     f"requested {rounds} — re-run with rounds >= {done}")
-            hist = {k: m[k] for k in m.files
-                    if k not in ("rounds_done", "wall_s")}
-            wall = float(m["wall_s"])
-        target = {"state": sim.init_state(), "topo": running_topo(sim)}
-        restored = restore(os.path.join(directory, f"state_{done}"),
-                           target)
-        state, topo = restored["state"], restored["topo"]
+            ckpt = {"state": canonical["state"],
+                    "topo": canonical["topo"],
+                    "meta": {"family": manifest["family"],
+                             "schedule": manifest.get(
+                                 "schedule",
+                                 _SCHEDULES[type(sim).__name__]),
+                             "state_class": manifest["state_class"],
+                             "topo_meta": manifest["topo_meta"]}}
+            sim, state, topo = from_canonical(sim, ckpt)
+            result_cls = _result_cls_named(manifest["result_class"])
+
+    # manifest top-level identity, shared by every generation this run
+    # persists (recomputed on resume from the CURRENT sim — equal by
+    # construction when the fingerprint matched)
+    base_manifest = {
+        "schema": SCHEMA_VERSION,
+        "fingerprint": (config_fingerprint(config_keys)
+                        if config_keys is not None else None),
+        "config_keys": config_keys,
+        "engine": engine or type(sim).__name__,
+        "family": fam,
+        "schedule": _SCHEDULES[type(sim).__name__],
+        "state_class": None,      # filled on first persist
+        "result_class": result_cls.__name__,
+        "topo_meta": None,        # filled on first persist
+        "checkpoints": [],
+    }
+
+    sim_cell = [sim]              # from_canonical may rebind the engine
 
     def persist(state, topo, hist, wall, done):
         import shutil
 
+        _kill_hook("before", done)
+        canonical = to_canonical(sim_cell[0], state, topo)
         save(os.path.join(directory, f"state_{done}"),
-             {"state": state, "topo": topo})
+             {"state": canonical["state"], "topo": canonical["topo"]})
+        _kill_hook("state", done)
+        hist_path = os.path.join(directory, f"history_{done}.npz")
         tmp = hist_path + ".tmp.npz"
         np.savez(tmp, rounds_done=done, wall_s=wall, **hist)
         os.replace(tmp, hist_path)
+        _kill_hook("history", done)
+        man = dict(base_manifest)
+        man["state_class"] = canonical["meta"]["state_class"]
+        man["topo_meta"] = canonical["meta"]["topo_meta"]
+        prev = [e for e in base_manifest["checkpoints"]
+                if int(e["round"]) != done]
+        man["checkpoints"] = (prev + [{
+            "round": done, "wall_s": wall,
+            "leaves": _leaf_crcs(canonical),
+        }])[-KEEP_CHECKPOINTS:]
+        _write_atomic(manifest_path,
+                      json.dumps(man, sort_keys=True))     # COMMIT
+        base_manifest["checkpoints"] = man["checkpoints"]
+        _kill_hook("manifest", done)
+        keep = {f"state_{int(e['round'])}" for e in man["checkpoints"]} \
+            | {f"history_{int(e['round'])}.npz"
+               for e in man["checkpoints"]} \
+            | {"manifest.json"}
         for name in os.listdir(directory):
-            if name.startswith("state_") and name != f"state_{done}":
-                shutil.rmtree(os.path.join(directory, name),
-                              ignore_errors=True)
+            if name in keep or not (name.startswith("state_")
+                                    or name.startswith("history")):
+                continue
+            p = os.path.join(directory, name)
+            if os.path.isdir(p):
+                shutil.rmtree(p, ignore_errors=True)
+            else:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+        _kill_hook("prune", done)
+        if on_chunk is not None:
+            on_chunk(done)
 
-    result, *_ = run_chunked(sim, rounds, every=every, state=state,
-                             topo=topo, hist=hist, wall=wall, done=done,
-                             after_chunk=persist)
+    # seed the retained-generation list from an existing manifest, so a
+    # resumed run's pruning never deletes the generation it restored
+    if resume and os.path.exists(manifest_path):
+        try:
+            with open(manifest_path) as fp:
+                base_manifest["checkpoints"] = json.load(fp).get(
+                    "checkpoints", [])
+        except Exception:  # noqa: BLE001 — legacy dir: start fresh
+            pass
+
+    result, *_ = run_chunked(sim_cell[0], rounds, every=every,
+                             state=state, topo=topo, hist=hist,
+                             wall=wall, done=done, after_chunk=persist,
+                             should_stop=should_stop,
+                             result_cls=result_cls)
     return result
+
+
+def _resume_legacy(sim, directory: str, rounds: int):
+    """Resume a pre-manifest checkpoint (history.npz + state_<N> holding
+    the writer's DEVICE-layout tree).  Same-layout only — the old
+    format is not self-describing, so elastic migration starts with the
+    first manifested checkpoint this run writes."""
+    import numpy as np
+
+    hist_path = os.path.join(directory, "history.npz")
+    with np.load(hist_path) as m:
+        done = int(m["rounds_done"])
+        if done > rounds:
+            raise CheckpointError(
+                f"checkpoint already contains {done} rounds > the "
+                f"requested {rounds} — re-run with rounds >= {done}")
+        hist = {k: m[k] for k in m.files
+                if k not in ("rounds_done", "wall_s")}
+        wall = float(m["wall_s"])
+    target = {"state": sim.init_state(), "topo": running_topo(sim)}
+    restored = restore(os.path.join(directory, f"state_{done}"), target)
+    return restored["state"], restored["topo"], hist, wall, done

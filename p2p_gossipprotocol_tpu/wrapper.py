@@ -95,18 +95,39 @@ class Peer:
             # also the engine under --checkpoint-every) with the stop
             # flag checked between chunks; result-type agnostic, so
             # every engine x mode the config can name rides this one
-            # loop.
-            from p2p_gossipprotocol_tpu.utils.checkpoint import \
-                run_chunked
+            # loop.  With the checkpoint_* config keys set, the same
+            # loop persists elastic checkpoints (run_with_checkpoints):
+            # stop() salvages at the next chunk boundary, and a
+            # checkpoint_resume=1 restart continues bitwise — on this
+            # or ANY engine layout of the same family.
+            from p2p_gossipprotocol_tpu.utils.checkpoint import (
+                run_chunked, run_with_checkpoints)
+
+            cfg = self.config
 
             def progress(state, topo, hist, wall, done):
                 self.rounds_completed = done
 
             try:
-                result, *_ = run_chunked(
-                    self._sim, rounds, every=self.JAX_ROUND_CHUNK,
-                    after_chunk=progress,
-                    should_stop=self._stop_event.is_set)
+                if cfg.checkpoint_every > 0 or cfg.checkpoint_resume:
+                    from p2p_gossipprotocol_tpu.engines import config_keys
+
+                    def on_chunk(done):
+                        self.rounds_completed = done
+
+                    result = run_with_checkpoints(
+                        self._sim, rounds,
+                        every=cfg.checkpoint_every or rounds,
+                        directory=cfg.checkpoint_dir,
+                        resume=bool(cfg.checkpoint_resume),
+                        should_stop=self._stop_event.is_set,
+                        config_keys=config_keys(cfg),
+                        engine=self.engine, on_chunk=on_chunk)
+                else:
+                    result, *_ = run_chunked(
+                        self._sim, rounds, every=self.JAX_ROUND_CHUNK,
+                        after_chunk=progress,
+                        should_stop=self._stop_event.is_set)
                 if result is not None:
                     self._result = result
             except Exception as e:  # noqa: BLE001 — surface via join()

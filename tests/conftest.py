@@ -56,14 +56,16 @@ def devices8():
     return devs[:8]
 
 
-# -- per-test timeout guard for the socket suites ----------------------
+# -- per-test timeout guard for the socket + subprocess suites ---------
 # The socket tests drive real TCP nodes with daemon threads; a wedged
 # accept/recv used to hang the WHOLE tier-1 run until the outer
 # 870-second kill (observed: the seed suite died at the timeout with the
 # tail of the run never executed).  SIGALRM interrupts the blocking
 # syscall in the main thread and fails ONE test with a readable error
 # instead.  Scoped by module name, so any suite touching real sockets
-# (test_socket_*, test_transport, ...) is covered automatically.
+# (test_socket_*, test_transport, ...) is covered automatically — and
+# the preemption suite (test_preemption drives kill/resume CLI
+# subprocesses, which can wedge the same way) rides the same guard.
 
 SOCKET_TEST_TIMEOUT_S = 120
 
@@ -73,14 +75,16 @@ def _socket_suite_timeout(request):
     import signal
 
     mod = getattr(request.module, "__name__", "")
-    if "socket" not in mod or not hasattr(signal, "SIGALRM"):
+    guarded = "socket" in mod or "preemption" in mod
+    if not guarded or not hasattr(signal, "SIGALRM"):
         yield
         return
 
     def _fire(signum, frame):
         raise TimeoutError(
             f"socket-suite test exceeded {SOCKET_TEST_TIMEOUT_S}s "
-            "(per-test guard; a blocking accept/recv wedged)")
+            "(per-test guard; a blocking accept/recv or subprocess "
+            "wedged)")
 
     old = signal.signal(signal.SIGALRM, _fire)
     signal.alarm(SOCKET_TEST_TIMEOUT_S)

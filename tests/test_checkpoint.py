@@ -231,10 +231,12 @@ def test_run_with_checkpoints_2d_mesh(tmp_path, devices8):
 
 
 def test_checkpoint_layout_is_crash_safe(tmp_path):
-    """Review contract: each chunk lands in a fresh state_<round> dir,
-    the sidecar is atomically replaced AFTER the state, and stale dirs
-    are pruned — so a kill at any instant leaves the sidecar pointing
-    at a complete state.  Also: resume without a checkpoint is a hard
+    """Review contract: each generation lands as state_<round> +
+    history_<round>.npz BEFORE the manifest is atomically replaced to
+    point at it, the last TWO generations are retained (the corruption
+    fallback needs the previous intact one) and older ones pruned — so
+    a kill at any instant leaves the manifest naming complete
+    generations only.  Also: resume without a checkpoint is a hard
     error, and resuming with fewer rounds than checkpointed refuses."""
     import os
 
@@ -250,16 +252,347 @@ def test_checkpoint_layout_is_crash_safe(tmp_path):
         checkpoint.run_with_checkpoints(mk(), 8, every=4, directory=d,
                                         resume=True)
 
-    checkpoint.run_with_checkpoints(mk(), 8, every=4, directory=d)
+    checkpoint.run_with_checkpoints(mk(), 12, every=4, directory=d)
     entries = sorted(os.listdir(d))
-    assert entries == ["history.npz", "state_8"]   # stale state_4 pruned
+    # generations 4 pruned; 8 retained as the corruption fallback
+    assert entries == ["history_12.npz", "history_8.npz",
+                       "manifest.json", "state_12", "state_8"]
 
-    with pytest.raises(ValueError, match="re-run with rounds >= 8"):
+    with pytest.raises(ValueError, match="re-run with rounds >= 12"):
         checkpoint.run_with_checkpoints(mk(), 4, every=4, directory=d,
                                         resume=True)
 
     # resume exactly at the stored round count: nothing re-runs, the
     # stored history comes back whole
-    res = checkpoint.run_with_checkpoints(mk(), 8, every=4, directory=d,
+    res = checkpoint.run_with_checkpoints(mk(), 12, every=4, directory=d,
                                           resume=True)
+    assert len(res.coverage) == 12
+
+
+def test_manifest_schema_pinned(tmp_path):
+    """The manifest schema is a COMPATIBILITY contract: old checkpoints
+    must stay readable, so adding/renaming fields requires a schema
+    bump plus a reader for every older version.  This pin makes a
+    silent field change a test failure."""
+    import json
+    import os
+
+    topo = build_aligned(seed=2, n=1024, n_slots=6)
+    sim = AlignedSimulator(topo=topo, n_msgs=8, mode="push", seed=3)
+    d = str(tmp_path / "ck")
+    checkpoint.run_with_checkpoints(sim, 4, every=4, directory=d,
+                                    engine="aligned")
+
+    with open(os.path.join(d, "manifest.json")) as fp:
+        man = json.load(fp)
+    assert man["schema"] == checkpoint.SCHEMA_VERSION == 1
+    assert set(man) == {"schema", "fingerprint", "config_keys", "engine",
+                        "family", "schedule", "state_class",
+                        "result_class", "topo_meta", "checkpoints"}
+    assert man["engine"] == "aligned"
+    assert man["family"] == "aligned"
+    assert man["result_class"] == "SimResult"
+    assert man["state_class"] == "AlignedState"
+    (entry,) = man["checkpoints"]
+    assert set(entry) == {"round", "wall_s", "leaves"}
+    assert entry["round"] == 4
+    for leaf, info in entry["leaves"].items():
+        assert set(info) == {"crc32", "dtype", "shape"}
+        group, _ = leaf.split("/", 1)
+        assert group in ("state", "topo")
+    # the state/topo leaves a reader needs are all CRC-covered
+    assert {"state/seen_w", "state/key", "state/round",
+            "topo/perm", "topo/colidx"} <= set(entry["leaves"])
+
+
+def test_fingerprint_mismatch_names_keys(tmp_path):
+    """Resuming under a drifted config fails with BOTH fingerprints and
+    the offending keys named — not an orbax shape error (the
+    n_peers/mode/engine drift satellite)."""
+    import pytest
+
+    topo = build_aligned(seed=2, n=1024, n_slots=6)
+    sim = AlignedSimulator(topo=topo, n_msgs=8, mode="push", seed=3)
+    keys_w = {"n_peers": 1024, "mode": "push", "engine": "aligned"}
+    d = str(tmp_path / "ck")
+    checkpoint.run_with_checkpoints(sim, 4, every=4, directory=d,
+                                    config_keys=keys_w)
+
+    keys_r = {"n_peers": 2048, "mode": "pushpull", "engine": "aligned"}
+    with pytest.raises(checkpoint.FingerprintMismatch) as ei:
+        checkpoint.run_with_checkpoints(
+            AlignedSimulator(topo=topo, n_msgs=8, mode="push", seed=3),
+            8, every=4, directory=d, resume=True, config_keys=keys_r)
+    msg = str(ei.value)
+    assert checkpoint.config_fingerprint(keys_w) in msg
+    assert checkpoint.config_fingerprint(keys_r) in msg
+    assert "n_peers" in msg and "1024" in msg and "2048" in msg
+    assert "mode" in msg
+
+    # matching keys resume fine
+    res = checkpoint.run_with_checkpoints(
+        AlignedSimulator(topo=topo, n_msgs=8, mode="push", seed=3),
+        8, every=4, directory=d, resume=True, config_keys=keys_w)
     assert len(res.coverage) == 8
+
+
+def test_corruption_modes_fall_back_or_name_the_defect(tmp_path, capsys):
+    """Every corruption mode yields a NAMED error or a documented
+    fallback — never a silent restart or an orbax traceback: truncated
+    sidecar, torn state dir, and CRC mismatch (naming the bad leaf) all
+    fall back to the previous intact generation; with no intact
+    generation left, restore refuses with the defect list."""
+    import json
+    import os
+    import shutil
+
+    import pytest
+
+    topo = build_aligned(seed=2, n=1024, n_slots=6)
+
+    def mk():
+        return AlignedSimulator(topo=topo, n_msgs=8, mode="pushpull",
+                                churn=ChurnConfig(rate=0.05, kill_round=1),
+                                seed=3)
+
+    full = mk().run(8)
+    d = str(tmp_path / "ck")
+    checkpoint.run_with_checkpoints(mk(), 8, every=4, directory=d)
+
+    def corrupt_resume():
+        res = checkpoint.run_with_checkpoints(mk(), 8, every=4,
+                                              directory=d, resume=True)
+        np.testing.assert_array_equal(res.coverage, full.coverage)
+        np.testing.assert_array_equal(np.asarray(res.state.seen_w),
+                                      np.asarray(full.state.seen_w))
+        return capsys.readouterr().err
+
+    # 1. truncated sidecar -> fallback to round 4, final state bitwise
+    with open(os.path.join(d, "history_8.npz"), "wb") as fp:
+        fp.write(b"torn")
+    err = corrupt_resume()
+    assert "history_8.npz is truncated" in err
+    assert "falling back to intact round 4" in err
+
+    # 2. CRC mismatch (manifest names the bad leaf) -> fallback
+    mpath = os.path.join(d, "manifest.json")
+    with open(mpath) as fp:
+        man = json.load(fp)
+    for e in man["checkpoints"]:
+        if e["round"] == 8:
+            e["leaves"]["state/seen_w"]["crc32"] ^= 1
+    with open(mpath, "w") as fp:
+        json.dump(man, fp)
+    err = corrupt_resume()
+    assert "CRC mismatch" in err and "state/seen_w" in err
+
+    # 3. torn state dir -> fallback
+    shutil.rmtree(os.path.join(d, "state_8"))
+    err = corrupt_resume()
+    assert "state_8 is missing or torn" in err
+
+    # 4. no intact generation left -> named refusal listing the defects
+    shutil.rmtree(os.path.join(d, "state_8"))
+    shutil.rmtree(os.path.join(d, "state_4"))
+    with pytest.raises(checkpoint.CorruptCheckpoint, match="no intact"):
+        checkpoint.run_with_checkpoints(mk(), 8, every=4, directory=d,
+                                        resume=True)
+
+
+def test_legacy_sidecar_still_resumes(tmp_path):
+    """Pre-manifest checkpoints (history.npz + device-layout state_<N>)
+    keep resuming — same layout only — including the old result-class
+    inference from the history keys."""
+    import numpy as np_
+
+    topo = build_aligned(seed=2, n=1024, n_slots=6)
+
+    def mk():
+        return AlignedSimulator(topo=topo, n_msgs=8, mode="pushpull",
+                                churn=ChurnConfig(rate=0.05, kill_round=1),
+                                seed=3)
+
+    full = mk().run(8)
+    # write a legacy-format checkpoint by hand (what the old runner did)
+    sim = mk()
+    half = sim.run(4)
+    d = tmp_path / "ck"
+    d.mkdir()
+    checkpoint.save(str(d / "state_4"),
+                    {"state": half.state, "topo": half.topo})
+    import dataclasses
+
+    hist = {f.name: getattr(half, f.name)
+            for f in dataclasses.fields(half)
+            if f.name not in ("state", "topo", "wall_s")}
+    np_.savez(str(d / "history.npz"), rounds_done=4, wall_s=half.wall_s,
+              **hist)
+
+    resumed = checkpoint.run_with_checkpoints(mk(), 8, every=4,
+                                              directory=str(d),
+                                              resume=True)
+    np.testing.assert_array_equal(resumed.coverage, full.coverage)
+    np.testing.assert_array_equal(np.asarray(resumed.state.seen_w),
+                                  np.asarray(full.state.seen_w))
+
+
+# ----------------------------------------------------------------------
+# Elastic migration: a checkpoint written on one engine layout resumes
+# on a DIFFERENT one, bitwise-identically to an uninterrupted run —
+# the acceptance contract's >= 3 writer -> reader pairs live here.
+
+
+def _aligned_migration_case(tmp_path, mk_writer, mk_reader, mk_ref,
+                            n_msgs=8):
+    topo = build_aligned(seed=5, n=2048, n_slots=6, rowblk=1, n_shards=8)
+    kw = dict(n_msgs=n_msgs, mode="pushpull",
+              churn=ChurnConfig(rate=0.05, kill_round=1), max_strikes=2,
+              seed=3)
+    full = mk_ref(topo, kw).run(8)
+    d = str(tmp_path / "ck")
+    checkpoint.run_with_checkpoints(mk_writer(topo, kw), 4, every=2,
+                                    directory=d)
+    resumed = checkpoint.run_with_checkpoints(mk_reader(topo, kw), 8,
+                                              every=2, directory=d,
+                                              resume=True)
+    np.testing.assert_array_equal(resumed.coverage, full.coverage)
+    np.testing.assert_array_equal(resumed.evictions, full.evictions)
+    np.testing.assert_array_equal(np.asarray(resumed.state.seen_w),
+                                  np.asarray(full.state.seen_w))
+    np.testing.assert_array_equal(np.asarray(resumed.state.alive_b),
+                                  np.asarray(full.state.alive_b))
+    np.testing.assert_array_equal(np.asarray(resumed.topo.colidx),
+                                  np.asarray(full.topo.colidx))
+    assert int(resumed.state.round) == 8
+
+
+def test_migrate_sharded4_to_single(tmp_path, devices8):
+    """Pair 1: aligned 1-D sharded N=4 writer -> single-device reader."""
+    from p2p_gossipprotocol_tpu.parallel import (AlignedShardedSimulator,
+                                                 make_mesh)
+
+    _aligned_migration_case(
+        tmp_path,
+        lambda t, kw: AlignedShardedSimulator(topo=t, mesh=make_mesh(4),
+                                              **kw),
+        lambda t, kw: AlignedSimulator(topo=t, **kw),
+        lambda t, kw: AlignedSimulator(topo=t, **kw))
+
+
+def test_migrate_single_to_2d(tmp_path, devices8):
+    """Pair 2: single-device writer -> 2-D (msgs x peers) mesh reader
+    (n_msgs=64 so the planes split over the msg axis)."""
+    from p2p_gossipprotocol_tpu.parallel import (Aligned2DShardedSimulator,
+                                                 make_mesh_2d)
+
+    _aligned_migration_case(
+        tmp_path,
+        lambda t, kw: AlignedSimulator(topo=t, **kw),
+        lambda t, kw: Aligned2DShardedSimulator(topo=t,
+                                                mesh=make_mesh_2d(2, 4),
+                                                **kw),
+        lambda t, kw: AlignedSimulator(topo=t, **kw),
+        n_msgs=64)
+
+
+def test_migrate_2d_to_sharded8(tmp_path, devices8):
+    """Pair 3: 2-D mesh writer -> 1-D sharded N=8 reader."""
+    from p2p_gossipprotocol_tpu.parallel import (
+        Aligned2DShardedSimulator, AlignedShardedSimulator, make_mesh,
+        make_mesh_2d)
+
+    _aligned_migration_case(
+        tmp_path,
+        lambda t, kw: Aligned2DShardedSimulator(topo=t,
+                                                mesh=make_mesh_2d(2, 2),
+                                                **kw),
+        lambda t, kw: AlignedShardedSimulator(topo=t, mesh=make_mesh(8),
+                                              **kw),
+        lambda t, kw: AlignedSimulator(topo=t, **kw),
+        n_msgs=64)
+
+
+def test_migrate_edges_mesh_resize(tmp_path, devices8):
+    """Pair 4: edges-sharded mesh RESIZE (8 -> 2 devices) — the one
+    elastic move the edges-sharded schedule admits (the exact/sharded
+    pair draw randomness differently; see the schedule guard test)."""
+    from p2p_gossipprotocol_tpu.parallel import ShardedSimulator, make_mesh
+
+    topo = graph.erdos_renyi(seed=7, n=1024, avg_degree=6)
+    kw = dict(n_msgs=8, mode="pushpull",
+              churn=ChurnConfig(rate=0.05, kill_round=1), max_strikes=2,
+              seed=3)
+    full = ShardedSimulator(topo=topo, mesh=make_mesh(8), **kw).run(8)
+    d = str(tmp_path / "ck")
+    checkpoint.run_with_checkpoints(
+        ShardedSimulator(topo=topo, mesh=make_mesh(8), **kw), 4, every=2,
+        directory=d)
+    resumed = checkpoint.run_with_checkpoints(
+        ShardedSimulator(topo=topo, mesh=make_mesh(2), **kw), 8, every=2,
+        directory=d, resume=True)
+    np.testing.assert_array_equal(resumed.coverage, full.coverage)
+    np.testing.assert_array_equal(resumed.evictions, full.evictions)
+    n = topo.n_peers
+    np.testing.assert_array_equal(np.asarray(resumed.state.seen)[:n],
+                                  np.asarray(full.state.seen)[:n])
+    # strikes live in a mesh-dependent slot layout — compare them in
+    # canonical (global edge order) form
+    from p2p_gossipprotocol_tpu.parallel.partition import unpartition_edges
+
+    np.testing.assert_array_equal(
+        unpartition_edges(resumed.topo, resumed.state.edge_strikes),
+        unpartition_edges(full.topo, full.state.edge_strikes))
+
+
+def test_cross_schedule_restore_refused(tmp_path, devices8):
+    """The exact and sharded edges engines draw randomness differently:
+    continuing one's checkpoint on the other would silently diverge, so
+    the restore refuses by name instead (migration-matrix contract)."""
+    import pytest
+
+    from p2p_gossipprotocol_tpu.parallel import ShardedSimulator, make_mesh
+
+    topo = graph.erdos_renyi(seed=7, n=1024, avg_degree=6)
+    kw = dict(n_msgs=8, mode="pushpull",
+              churn=ChurnConfig(rate=0.05, kill_round=1), seed=3)
+    d = str(tmp_path / "ck")
+    checkpoint.run_with_checkpoints(
+        ShardedSimulator(topo=topo, mesh=make_mesh(8), **kw), 4, every=4,
+        directory=d)
+    with pytest.raises(checkpoint.CheckpointError,
+                       match="cross-schedule"):
+        checkpoint.run_with_checkpoints(
+            Simulator(topo=topo, **kw), 8, every=4, directory=d,
+            resume=True)
+
+
+def test_crash_schedule_resumes_bitwise(tmp_path):
+    """Fault plans key every draw on (plan seed, round, global id) —
+    never the simulation's PRNG chain — so a crash/recovery-scheduled
+    run checkpointed mid-schedule replays the remaining schedule
+    bit-identically after restore (the faults.py checkpoint-safety
+    contract)."""
+    from p2p_gossipprotocol_tpu import faults as faults_lib
+
+    topo = build_aligned(seed=2, n=1024, n_slots=6)
+    plan = faults_lib.FaultPlan.parse(
+        "drop=0.1,crash=3:0.3,recover=6:0.5,partition=2:5")
+
+    def mk():
+        return AlignedSimulator(topo=topo, n_msgs=8, mode="pushpull",
+                                faults=plan, seed=3)
+
+    full = mk().run(8)
+    d = str(tmp_path / "ck")
+    # chunk boundary lands INSIDE the crash->recover window
+    checkpoint.run_with_checkpoints(mk(), 4, every=4, directory=d)
+    resumed = checkpoint.run_with_checkpoints(mk(), 8, every=4,
+                                              directory=d, resume=True)
+    np.testing.assert_array_equal(resumed.coverage, full.coverage)
+    np.testing.assert_array_equal(resumed.live_peers, full.live_peers)
+    np.testing.assert_array_equal(resumed.redeliveries,
+                                  full.redeliveries)
+    np.testing.assert_array_equal(np.asarray(resumed.state.seen_w),
+                                  np.asarray(full.state.seen_w))
+    np.testing.assert_array_equal(np.asarray(resumed.state.alive_b),
+                                  np.asarray(full.state.alive_b))

@@ -144,3 +144,61 @@ def test_facade_reaches_sharded_engines_from_config(tmp_path, devices8):
         assert len(result.coverage) == 8, name
         assert result.coverage[-1] > 0.9, name
         assert not peer.is_running()
+
+
+def test_facade_elastic_checkpoint_salvage_and_resume(tmp_path):
+    """The checkpoint_* config keys give the FACADE the same elastic
+    contract as the CLI: stop() salvages a checkpoint at the next chunk
+    boundary, and a fresh Peer with checkpoint_resume=1 — on a
+    DIFFERENT engine layout, here sharded-4 writer -> single-device
+    reader — continues into the exact result an uninterrupted run
+    produces."""
+    import numpy as np
+
+    import jax
+
+    if len(jax.devices()) < 4:
+        import pytest
+
+        pytest.skip("needs 4 virtual devices")
+
+    ck = tmp_path / "ck"
+    base = ("10.0.0.1:8000\nbackend=jax\nengine=aligned\nn_peers=2048\n"
+            "avg_degree=6\nmode=pushpull\nchurn_rate=0.05\nrounds=12\n"
+            "prng_seed=0\nn_messages=8\n")
+
+    # the uninterrupted reference runs the WRITER's scenario: the
+    # row-block grid (and so the overlay tables from_config draws)
+    # depends on the mesh the topology was built for, so the reference
+    # must share the writer's mesh_devices — the elastic contract is
+    # "same run, different reader layout", not "any layout's run"
+    cfg_ref = tmp_path / "net_ref.txt"
+    cfg_ref.write_text(base + "mesh_devices=4\n")
+    ref = Peer(str(cfg_ref))
+    ref.start()
+    full = ref.join(timeout=300)
+
+    cfg_w = tmp_path / "net_w.txt"
+    cfg_w.write_text(base + "mesh_devices=4\n"
+                     f"checkpoint_every=4\ncheckpoint_dir={ck}\n")
+    writer = Peer(str(cfg_w))
+    writer.start()
+    deadline = time.monotonic() + 120
+    while (writer.rounds_completed < 4 and writer.is_running()
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    writer.stop()                                # salvage at boundary
+    assert not writer.is_running()
+    assert (ck / "manifest.json").exists()
+
+    cfg_r = tmp_path / "net_r.txt"
+    cfg_r.write_text(base + "mesh_devices=0\n"
+                     f"checkpoint_every=4\ncheckpoint_dir={ck}\n"
+                     "checkpoint_resume=1\n")
+    reader = Peer(str(cfg_r))
+    reader.start()
+    resumed = reader.join(timeout=300)
+    assert resumed is not None
+    np.testing.assert_array_equal(resumed.coverage, full.coverage)
+    np.testing.assert_array_equal(np.asarray(resumed.state.seen_w),
+                                  np.asarray(full.state.seen_w))
