@@ -46,7 +46,11 @@ reachable as ``bench.py --faults SPEC``) — the run executes under the
 fault plan and the result line carries a ``faults`` column, so
 BENCH_*.json rows can track fault-plane overhead and
 coverage-under-faults over time.  Unset/empty = no faults (the column
-reads null).
+reads null).  GOSSIP_BENCH_FLEET_B (0 = off): also serve B
+independent-seed scenarios as one batched fleet bucket (fleet/) at
+GOSSIP_BENCH_FLEET_PEERS (64k) and report fleet_wall_s /
+fleet_ms_per_scenario — the amortized sweep-throughput column; the
+solo-vs-fleet A/B lives in benchmarks/measure_round7.py.
 """
 
 from __future__ import annotations
@@ -78,13 +82,26 @@ def _fault_plan():
     return FaultPlan.parse(spec)
 
 
+def _env_int(name: str, default: int) -> int:
+    """int env knob with the timeout knobs' try/except-default
+    discipline: a malformed value must not take down the bench line
+    (the whole harness exists so a round never ends with no
+    datapoint)."""
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        print(f"[bench] malformed {name}={os.environ.get(name)!r}; "
+              f"using default {default}", file=sys.stderr)
+        return default
+
+
 def _check_every() -> int:
     """GOSSIP_BENCH_CHECK_EVERY clamped to [1, MAX_ROUNDS] — a K that
     never fits under MAX_ROUNDS would silently run the per-round tail
     while the row claims K, and 0 (a natural "off" spelling) must mean
     per-round, not a crash.  One definition for both engines."""
-    return max(1, min(int(os.environ.get("GOSSIP_BENCH_CHECK_EVERY",
-                                         "1")), MAX_ROUNDS))
+    return max(1, min(_env_int("GOSSIP_BENCH_CHECK_EVERY", 1),
+                      MAX_ROUNDS))
 
 
 def _call_with_timeout(fn, timeout_s: float | None):
@@ -261,8 +278,18 @@ def _bench_aligned(n, n_msgs, degree, mode):
     # back to off when a guard rejects it (below), while an explicit
     # GOSSIP_BENCH_PULL_WINDOW=1 lets the guard error surface.
     pw_env = os.environ.get("GOSSIP_BENCH_PULL_WINDOW")
-    pull_window = (bool(int(pw_env)) if pw_env is not None
-                   else bool(roll_groups) and mode != "push")
+    if pw_env is not None:
+        try:
+            pull_window = bool(int(pw_env))
+        except ValueError:
+            # malformed knob must not kill the bench line — fall back
+            # to the default selection and say so
+            print(f"[bench] malformed GOSSIP_BENCH_PULL_WINDOW="
+                  f"{pw_env!r}; using the default selection",
+                  file=sys.stderr)
+            pw_env = None
+    if pw_env is None:
+        pull_window = bool(roll_groups) and mode != "push"
     # Coverage-census cadence inside the while loop (run_to_coverage
     # check_every): the census is a per-round sync barrier; K>1 checks
     # after each K-round chunk, may overshoot by <K rounds (counted in
@@ -337,6 +364,45 @@ def _bench_aligned(n, n_msgs, degree, mode):
             print(f"[bench] steady scan {status}"
                   + (f" ({value})" if status == "error" else "")
                   + "; omitting steady fields", file=sys.stderr)
+    # Fleet column (GOSSIP_BENCH_FLEET_B > 0): serve B same-family
+    # scenarios (independent seeds) as ONE batched fleet bucket at
+    # GOSSIP_BENCH_FLEET_PEERS and report the amortized per-scenario
+    # cost — the sweep-throughput number the fleet engine exists for.
+    # The full A/B against B sequential solo launches lives in
+    # benchmarks/measure_round7.py; a fleet failure here degrades to a
+    # line without fleet fields, never to no line.
+    fleet = {}
+    fleet_b = _env_int("GOSSIP_BENCH_FLEET_B", 0)
+    if fleet_b > 0:
+        try:
+            from p2p_gossipprotocol_tpu.fleet import FleetBucket
+            fn_peers = _env_int("GOSSIP_BENCH_FLEET_PEERS", 1 << 16)
+            fsims = []
+            for s in range(fleet_b):
+                ftopo = build_aligned(seed=s, n=fn_peers, n_slots=degree,
+                                      degree_law="powerlaw",
+                                      roll_groups=roll_groups,
+                                      n_msgs=n_msgs, rowblk=rowblk,
+                                      block_perm=block_perm)
+                fsims.append(AlignedSimulator(
+                    topo=ftopo, n_msgs=n_msgs, mode=mode,
+                    churn=ChurnConfig(rate=churn_rate, kill_round=1),
+                    max_strikes=3, liveness_every=liveness_every,
+                    message_stagger=stagger, fuse_update=fuse_update,
+                    pull_window=pull_window, faults=plan, seed=s))
+            bres = FleetBucket(fsims).run(MAX_ROUNDS, target=TARGET_COV,
+                                          check_every=check_every)
+            fleet = {
+                "fleet_b": fleet_b, "fleet_n_peers": fn_peers,
+                "fleet_wall_s": round(bres.wall_s, 4),
+                "fleet_ms_per_scenario": round(
+                    bres.wall_s / fleet_b * 1e3, 1),
+                "fleet_converged": int(bres.converged.sum()),
+                "fleet_rounds_max": int(bres.rounds_run.max()),
+            }
+        except Exception as e:  # noqa: BLE001 — column, not the line
+            print(f"[bench] fleet column failed ({type(e).__name__}: "
+                  f"{e}); omitting fleet fields", file=sys.stderr)
     extras = {
         "liveness_every": liveness_every,
         "roll_groups": roll_groups,
@@ -354,6 +420,7 @@ def _bench_aligned(n, n_msgs, degree, mode):
         "achieved_gb_s": (round(bytes_round * rounds / wall / 1e9, 1)
                           if wall > 0 else None),
         **steady,
+        **fleet,
     }
     return rounds, wall, total_seen, n_edges, graph_s, extras
 

@@ -1,0 +1,188 @@
+"""Round-7 A/B: B sequential solo AlignedSimulator runs vs ONE fleet
+launch of the same B scenarios — the direct measurement behind the
+fleet engine (fleet/, docs/ARCHITECTURE.md "The fleet engine").
+
+Each B in {16, 64, 256} (GOSSIP_R7_B) builds a heterogeneous sweep —
+per-scenario seeds, a quarter of the peer counts off-grid (padded back
+up by the spec layer, exercising the packer), an eighth of the
+scenarios on mode=pull (a second signature bucket) — and measures:
+
+* ``fleet_ab_b{B}_solo``: the B scenarios served one after another on
+  the solo engine, in ONE process with a warm XLA cache.  This is the
+  CONSERVATIVE baseline — a real sequential sweep (one launch per
+  scenario) also pays process start + jax import + compile per
+  scenario, which the fleet amortizes to once per bucket.
+* ``fleet_ab_b{B}_fleet``: the same scenarios as a fleet launch
+  (FleetSweep.run, fixed rounds, no convergence masking — the
+  bitwise-parity setting).  The row records the measured ``speedup``
+  against the landed solo row and ``parity_ok``: the fleet results of
+  the first/last scenario are compared bitwise against the solo runs
+  (the full cross-product lives in tests/test_fleet.py).
+
+Acceptance (ISSUE 4): B=64 at 64k peers on the CPU bench path >= 5x.
+
+Run on the chip (the watchdog chain step measure_round7):
+    PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/measure_round7.py
+Appends one JSON row per measurement to GOSSIP_R7_OUT (default
+benchmarks/results/round7_tpu.jsonl on TPU, round7_cpu.jsonl
+elsewhere), resuming per-config like the round-4/5/6 drivers.  Unlike
+round 6 there is no CPU refusal gate: the A/B is a within-platform
+ratio, and the acceptance number IS the CPU one.  Scale knobs:
+GOSSIP_R7_PEERS (64k), GOSSIP_R7_ROUNDS (8), GOSSIP_R7_B
+(default "16,64,256").
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+
+def _out_path(cpu: bool) -> str:
+    default = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "results",
+        "round7_cpu.jsonl" if cpu else "round7_tpu.jsonl")
+    return os.environ.get("GOSSIP_R7_OUT", default)
+
+
+OUT = None          # set in main() once the platform is known
+
+
+def emit(row):
+    row["device"] = str(jax.devices()[0]).replace(" ", "_")
+    row["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    print(json.dumps(row), flush=True)
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def _landed() -> set:
+    from benchmarks._common import landed
+    return landed(OUT)
+
+
+def _landed_row(tag):
+    try:
+        with open(OUT) as f:
+            for line in f:
+                row = json.loads(line)
+                if row.get("config") == tag:
+                    return row
+    except OSError:
+        pass
+    return None
+
+
+def _specs(b: int, n: int) -> list[dict]:
+    """B heterogeneous scenario lines: per-scenario seeds, every 4th
+    peer count off the power-of-two grid (the spec layer pads it back —
+    the packer still lands few buckets), every 8th scenario on
+    mode=pull (a second program signature, so the fleet launch also
+    covers the multi-bucket path)."""
+    specs = []
+    for s in range(b):
+        line = {"prng_seed": s}
+        if s % 4 == 1:
+            line["n_peers"] = n - n // 8
+        if s % 8 == 5:
+            line["mode"] = "pull"
+        specs.append(line)
+    return specs
+
+
+def _sweep(b: int, n: int, rounds: int):
+    """A FleetSweep over _specs — built through the same NetworkConfig
+    path the CLI takes, so spec resolution/padding/packing all run."""
+    from p2p_gossipprotocol_tpu.config import NetworkConfig
+    from p2p_gossipprotocol_tpu.fleet import FleetSweep
+
+    cfg_text = (f"127.0.0.1:8000\nbackend=jax\nengine=fleet\n"
+                f"n_peers={n}\nn_messages=16\navg_degree=8\n"
+                f"rounds={rounds}\nchurn_rate=0.05\n")
+    with tempfile.NamedTemporaryFile("w", suffix=".txt",
+                                     delete=False) as f:
+        f.write(cfg_text)
+        path = f.name
+    try:
+        cfg = NetworkConfig(path)
+        return FleetSweep.from_config(cfg, specs=_specs(b, n))
+    finally:
+        os.unlink(path)
+
+
+def _state_equal(a, b) -> bool:
+    for k in ("seen_w", "frontier_w", "alive_b", "byz_w", "key",
+              "round"):
+        if not np.array_equal(
+                np.asarray(jax.device_get(getattr(a.state, k))),
+                np.asarray(jax.device_get(getattr(b.state, k)))):
+            return False
+    return bool(np.array_equal(np.asarray(a.coverage),
+                               np.asarray(b.coverage)))
+
+
+def bench_fleet_ab(b: int, n: int, rounds: int, done):
+    solo_tag, fleet_tag = f"fleet_ab_b{b}_solo", f"fleet_ab_b{b}_fleet"
+    if solo_tag in done and fleet_tag in done:
+        return
+    sweep = _sweep(b, n, rounds)
+    sims = [s.sim for s in sweep.scenarios]
+
+    solo_results = {}
+    if solo_tag not in done:
+        t0 = time.perf_counter()
+        for i, sim in enumerate(sims):
+            res = sim.run(rounds)
+            if i in (0, b - 1):
+                solo_results[i] = res
+        solo_wall = time.perf_counter() - t0
+        emit({"config": solo_tag, "b": b, "n_peers": n,
+              "rounds": rounds, "wall_s": round(solo_wall, 4),
+              "ms_per_scenario": round(solo_wall / b * 1e3, 1)})
+    else:
+        solo_wall = _landed_row(solo_tag)["wall_s"]
+        for i in (0, b - 1):
+            solo_results[i] = sims[i].run(rounds)
+
+    if fleet_tag not in done:
+        t0 = time.perf_counter()
+        sres = sweep.run(rounds, target=None)
+        fleet_wall = time.perf_counter() - t0
+        parity = (_state_equal(sres.results[0], solo_results[0])
+                  and _state_equal(sres.results[b - 1],
+                                   solo_results[b - 1]))
+        emit({"config": fleet_tag, "b": b, "n_peers": n,
+              "rounds": rounds, "n_buckets": sres.n_buckets,
+              "wall_s": round(fleet_wall, 4),
+              "ms_per_scenario": round(fleet_wall / b * 1e3, 1),
+              "speedup": round(solo_wall / fleet_wall, 2),
+              "parity_ok": parity})
+
+
+def main():
+    global OUT
+    backend = jax.default_backend()
+    on_tpu = backend in ("tpu", "axon")
+    OUT = _out_path(cpu=not on_tpu)
+    n = int(os.environ.get("GOSSIP_R7_PEERS", str(1 << 16)))
+    rounds = int(os.environ.get("GOSSIP_R7_ROUNDS", "8"))
+    bs = [int(x) for x in
+          os.environ.get("GOSSIP_R7_B", "16,64,256").split(",") if x]
+    done = _landed()
+    if "_backend" not in done:
+        emit({"config": "_backend", "backend": backend, "n_peers": n,
+              "rounds": rounds})
+    for b in bs:
+        bench_fleet_ab(b, n, rounds, done)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

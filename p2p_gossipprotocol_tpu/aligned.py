@@ -1100,7 +1100,9 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
                   msg_reduce=None, honest_mask: jax.Array | None = None,
                   junk_mask: jax.Array | None = None,
                   w_off: jax.Array | int = 0,
-                  msg_only_reduce=None
+                  msg_only_reduce=None,
+                  hash_seed: jax.Array | None = None,
+                  msg_srcs: jax.Array | None = None
                   ) -> tuple[AlignedState, AlignedTopology, dict]:
     """THE round implementation, shared by the single-chip engine,
     AlignedShardedSimulator (parallel/aligned_sharded.py) and the 2-D
@@ -1122,10 +1124,19 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
       * ``honest_mask``/``junk_mask`` — this caller's slice of the
         per-plane masks (int32[W_local]); default: the sim's full-width
         masks (the message axis is unsharded).
+      * ``hash_seed``/``msg_srcs`` — per-SCENARIO overrides for the
+        fleet engine (fleet/engine.py vmaps this round over a scenario
+        axis): the liveness rewire-hash seed (defaults to the static
+        ``sim.seed``) and the staggered-generation source table
+        (defaults to ``sim._message_plan()``).  Both default to the
+        solo engine's values, so every existing caller compiles the
+        exact program it always did.
     Everything else — churn, strikes/rewire, byzantine, gossip passes,
     metrics — is this one code path, so the engines cannot drift."""
     if msg_reduce is None:
         msg_reduce = reduce
+    if hash_seed is None:
+        hash_seed = sim.seed
     if msg_only_reduce is None:        # sums over MESSAGE shards only —
         msg_only_reduce = (lambda x: x)  # identity unless planes shard
     hmask = sim._honest_mask if honest_mask is None else honest_mask
@@ -1213,7 +1224,7 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
             col2, stk2, evict8 = liveness_pass(
                 y_alive, col, stk, topo.deg, rolls_off, topo.subrolls,
                 gbase=grows[::blk], round_idx=state.round,
-                hash_seed=sim.seed,
+                hash_seed=hash_seed,
                 ytab=ytab_local if fused else None,
                 max_strikes=sim.max_strikes,
                 rowblk=topo.rowblk, interpret=sim.interpret)
@@ -1254,7 +1265,7 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
         k = sim.message_stagger
         r = state.round
         m = r // k
-        _, srcs = sim._message_plan()
+        srcs = sim._message_plan()[1] if msg_srcs is None else msg_srcs
         src = srcs[jnp.clip(m, 0, sim.n_msgs - 1)]
         grow, lane = src // LANES, src % LANES
         W_l, rows_l = seen_w.shape[0], seen_w.shape[1]

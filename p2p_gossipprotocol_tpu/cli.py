@@ -51,11 +51,28 @@ def build_parser() -> argparse.ArgumentParser:
                    default=None,
                    help="jax mode: overlay model override (same as the "
                         "graph= config key)")
-    p.add_argument("--engine", choices=["edges", "aligned"],
+    p.add_argument("--engine", choices=["edges", "aligned", "fleet"],
                    default=None,
-                   help="jax mode: exact edge-list engine, or the "
-                        "hardware-aligned pallas engine (1M+ peers); "
-                        "default: the config's engine= key (edges)")
+                   help="jax mode: exact edge-list engine, the "
+                        "hardware-aligned pallas engine (1M+ peers), or "
+                        "the fleet engine (batched multi-scenario "
+                        "sweeps — needs --sweep); default: the "
+                        "config's engine= key (edges)")
+    p.add_argument("--sweep", default=None, metavar="SPECS",
+                   help="jax mode: serve a batched multi-scenario sweep "
+                        "(engine=fleet): SPECS is a JSONL file, one "
+                        "scenario of config-key overrides per line "
+                        "(e.g. {\"prng_seed\": 3, \"mode\": \"pull\", "
+                        "\"fault_link_drop\": 0.1}).  Scenarios bucket "
+                        "by program shape and run batched on one "
+                        "device; every result is bitwise-identical to "
+                        "the scenario's solo run (docs/ARCHITECTURE.md "
+                        "fleet section)")
+    p.add_argument("--sweep-results", default=None, metavar="PATH",
+                   help="fleet mode: write the per-scenario results "
+                        "table (JSONL) here; default: the "
+                        "sweep_results= config key, else rows print to "
+                        "stdout")
     p.add_argument("--mesh-devices", type=int, default=None, metavar="N",
                    help="jax mode: shard the peer axis over an N-device "
                         "mesh (ShardedSimulator / "
@@ -162,7 +179,9 @@ def _run_jax(cfg: NetworkConfig, args) -> int:
         print(f"Error: {e}", file=sys.stderr)
         return 1
     for c in clamps:
-        print(f"Warning: --engine aligned clamped {c}", file=sys.stderr)
+        print(f"Warning: engine clamped {c}", file=sys.stderr)
+    if engine == "fleet":
+        return _run_fleet(sim, cfg, args, rounds)
     n = sim.topo.n_peers
     if not args.quiet:
         if cfg.mode == "sir":
@@ -217,6 +236,73 @@ def _run_jax(cfg: NetworkConfig, args) -> int:
         print(f"[checkpoint] salvage checkpoint covers {done}/{rounds} "
               "rounds — exiting resumable (75)", file=sys.stderr)
         return EX_RESUMABLE
+    return 0
+
+
+def _run_fleet(sweep, cfg, args, rounds) -> int:
+    """Drive a fleet sweep (engine=fleet): per-bucket batched serving,
+    a per-scenario JSONL results table, and the same preemption
+    contract as the solo checkpoint runner — SIGINT/SIGTERM salvage the
+    in-flight bucket at the next chunk boundary and exit 75
+    (resumable); --resume skips completed buckets and continues the
+    interrupted one bitwise."""
+    from p2p_gossipprotocol_tpu.utils.checkpoint import (CheckpointError,
+                                                         EX_RESUMABLE)
+
+    # sweep_target=0 (the default) falls back to --target-coverage;
+    # --target-coverage 0 disables convergence masking entirely (every
+    # scenario runs the full fixed round count).
+    target = cfg.sweep_target or args.target_coverage
+    target = target if target > 0 else None
+    stop = {"flag": False}
+    if args.checkpoint_dir:
+        def handler(signum, frame):
+            print("\nReceived signal to terminate — salvaging the "
+                  "in-flight bucket at the next chunk boundary, then "
+                  "exiting resumable (code 75; re-run with --resume).",
+                  file=sys.stderr)
+            stop["flag"] = True
+
+        signal.signal(signal.SIGINT, handler)
+        signal.signal(signal.SIGTERM, handler)
+    if not args.quiet:
+        print(f"[jax/fleet] serving {sweep.n_scenarios} scenarios in "
+              f"{len(sweep.buckets)} bucket(s), rounds<={rounds}, "
+              f"target={target if target is not None else 'off'}")
+    log = None if args.quiet else (
+        lambda msg: print(msg, file=sys.stderr))
+    try:
+        res = sweep.run(rounds, target=target,
+                        checkpoint_dir=args.checkpoint_dir,
+                        checkpoint_every=args.checkpoint_every,
+                        resume=args.resume,
+                        should_stop=lambda: stop["flag"], log=log)
+    except CheckpointError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    if not res.results_path:
+        for row in res.rows:
+            print(json.dumps(row))
+    summary = {
+        "engine": "fleet",
+        "n_scenarios": res.n_scenarios,
+        "n_buckets": res.n_buckets,
+        "scenarios_served": len(res.rows),
+        "converged": sum(1 for r in res.rows if r.get("converged")),
+        "wall_s": round(res.wall_s, 4),
+    }
+    if res.results_path:
+        summary["results"] = res.results_path
+    if res.interrupted:
+        summary["interrupted"] = True
+    print(json.dumps(summary))
+    if res.interrupted:
+        if args.checkpoint_dir and len(res.rows) < res.n_scenarios:
+            print(f"[checkpoint] sweep salvaged after {len(res.rows)}/"
+                  f"{res.n_scenarios} scenarios — exiting resumable "
+                  "(75)", file=sys.stderr)
+            return EX_RESUMABLE
+        return 1
     return 0
 
 
@@ -365,6 +451,17 @@ def main(argv: list[str] | None = None) -> int:
         cfg.wire_format = args.wire_format
     if args.engine:
         cfg.engine = args.engine
+    if args.sweep:
+        # --sweep implies the fleet engine: the spec file IS the sweep
+        cfg.sweep_file = args.sweep
+        cfg.engine = "fleet"
+    if args.sweep_results:
+        cfg.sweep_results = args.sweep_results
+    if cfg.engine == "fleet" and cfg.backend != "jax":
+        print("Error: engine=fleet is a jax-backend feature (the "
+              "socket runtime is one real peer process)",
+              file=sys.stderr)
+        return 1
     args.engine = cfg.engine
     if args.fault_plan:
         from p2p_gossipprotocol_tpu import faults as faults_lib

@@ -151,6 +151,13 @@ _SIM_INT_KEYS = {
     # canonical (utils/checkpoint.py).
     "checkpoint_every": "checkpoint_every",
     "checkpoint_resume": "checkpoint_resume",
+    # Fleet engine (engine=fleet; fleet/): widest scenario batch one
+    # bucket may hold (larger signature groups split), and whether
+    # scenario peer counts pad UP to the next power of two so
+    # heterogeneous sweeps share static-shape buckets (recorded per
+    # row as n_peers_requested vs n_peers — never silent).
+    "sweep_max_batch": "sweep_max_batch",
+    "sweep_pad_peers": "sweep_pad_peers",
 }
 _SIM_FLOAT_KEYS = {
     "er_p": "er_p",
@@ -166,6 +173,9 @@ _SIM_FLOAT_KEYS = {
     "fault_delay": "fault_delay",
     "fault_duplicate": "fault_duplicate",
     "fault_byzantine": "fault_byzantine",
+    # Fleet engine: coverage target for convergence masking + bucket
+    # early-exit (0 = run every scenario the full fixed round count).
+    "sweep_target": "sweep_target",
 }
 _SIM_STR_KEYS = {
     "local_ip": "local_ip",
@@ -187,6 +197,11 @@ _SIM_STR_KEYS = {
     # jax backend: where checkpoints live (required when
     # checkpoint_every/checkpoint_resume are set).
     "checkpoint_dir": "checkpoint_dir",
+    # Fleet engine: the sweep spec (JSONL, one scenario of config-key
+    # overrides per line — the config-file twin of --sweep) and where
+    # the per-scenario results table lands.
+    "sweep_file": "sweep_file",
+    "sweep_results": "sweep_results",
 }
 
 
@@ -262,6 +277,12 @@ class NetworkConfig:
         self.checkpoint_every = 0        # rounds per checkpoint; 0 = off
         self.checkpoint_dir = ""
         self.checkpoint_resume = 0       # 1 = continue from checkpoint_dir
+        # Fleet engine (engine=fleet): batched multi-scenario sweeps
+        self.sweep_file = ""             # JSONL scenario spec (--sweep)
+        self.sweep_results = ""          # per-scenario results table
+        self.sweep_max_batch = 256       # widest bucket (overflow splits)
+        self.sweep_pad_peers = 1         # pad n_peers to powers of two
+        self.sweep_target = 0.0          # >0 = early-exit coverage target
         self._load_config()
         self._validate_config()
 
@@ -380,7 +401,8 @@ class NetworkConfig:
                   "roll_groups", "fuse_update", "pull_window",
                   "rounds", "prng_seed", "anti_entropy_interval",
                   "message_stagger", "mesh_devices", "msg_shards",
-                  "checkpoint_every", "checkpoint_resume"):
+                  "checkpoint_every", "checkpoint_resume",
+                  "sweep_max_batch", "sweep_pad_peers"):
             if getattr(self, k) < 0:
                 raise ConfigError(f"{k} must be non-negative")
         if (self.checkpoint_every > 0 or self.checkpoint_resume) \
@@ -405,8 +427,10 @@ class NetworkConfig:
             raise ConfigError(f"Unknown wire_format: {self.wire_format}")
         if self.mode not in ("push", "pull", "pushpull", "sir"):
             raise ConfigError(f"Unknown gossip mode: {self.mode}")
-        if self.engine not in ("edges", "aligned"):
+        if self.engine not in ("edges", "aligned", "fleet"):
             raise ConfigError(f"Unknown engine: {self.engine}")
+        if not (0.0 <= self.sweep_target < 1.0):
+            raise ConfigError("sweep_target must be in [0, 1)")
         for k in ("sir_beta", "sir_gamma"):
             if not (0.0 <= getattr(self, k) <= 1.0):
                 raise ConfigError(f"{k} must be in [0, 1]")

@@ -1,0 +1,48 @@
+"""Fleet engine: batched multi-scenario serving on one chip.
+
+Every other engine in the repo serves exactly one scenario per process,
+so a parameter sweep or a multi-tenant workload pays full launch +
+compile + dispatch cost per scenario.  The fleet subsystem applies the
+inference-serving answer — pad scenarios to static-shape buckets and
+batch them through one kernel launch (the dense-hardware trick of "Fast
+Training of Sparse Graph Neural Networks on Dense Hardware",
+PAPERS.md), with PeerSwap-style independent per-scenario randomness
+streams so batching never correlates what should be independent
+experiments:
+
+* :mod:`~p2p_gossipprotocol_tpu.fleet.spec` — scenario specs: per-line
+  overrides of any ``NetworkConfig`` key, resolved to the exact solo
+  :class:`~p2p_gossipprotocol_tpu.aligned.AlignedSimulator` the CLI
+  would build for that scenario (same clamps machinery, never silent);
+* :mod:`~p2p_gossipprotocol_tpu.fleet.packer` — buckets scenarios by
+  their compiled-program signature (padded topology shape, message
+  width, mode/fanout/churn/fault statics) so each bucket is ONE
+  static-shape compilation;
+* :mod:`~p2p_gossipprotocol_tpu.fleet.engine` — ``jax.vmap``s the ONE
+  shared round implementation (:func:`aligned.aligned_round`) over the
+  scenario axis, with per-scenario fold-in of seed/churn/fanout/fault
+  randomness (fault keying stays ``(plan-seed, round, id)``, so batched
+  and solo fault schedules replay bitwise), convergence masking, and
+  bucket early-exit;
+* :mod:`~p2p_gossipprotocol_tpu.fleet.driver` — unpacks the batched
+  census into per-scenario ``SimResult``s, writes the sweep results
+  table (JSONL), and plugs into the canonical-checkpoint machinery so
+  a preempted sweep salvages and resumes per-bucket.
+
+The hard contract (tests/test_fleet.py): every scenario in a
+mixed-bucket sweep produces a result **bitwise-identical** to its solo
+``AlignedSimulator`` run.
+"""
+
+from p2p_gossipprotocol_tpu.fleet.driver import FleetSweep, SweepResult
+from p2p_gossipprotocol_tpu.fleet.engine import BucketResult, FleetBucket
+from p2p_gossipprotocol_tpu.fleet.packer import bucket_signature, pack
+from p2p_gossipprotocol_tpu.fleet.spec import (ScenarioSpec,
+                                               build_scenarios,
+                                               parse_sweep_file)
+
+__all__ = [
+    "FleetSweep", "SweepResult", "FleetBucket", "BucketResult",
+    "bucket_signature", "pack", "ScenarioSpec", "build_scenarios",
+    "parse_sweep_file",
+]

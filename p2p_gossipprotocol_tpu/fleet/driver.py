@@ -1,0 +1,367 @@
+"""Fleet driver: buckets → results table → elastic per-bucket resume.
+
+Runs a packed sweep bucket by bucket, unpacks every bucket's batched
+census into per-scenario ``SimResult``s, and maintains the sweep's two
+artifacts:
+
+* the **results table** — one JSON object per scenario (JSONL),
+  rewritten atomically as buckets complete, so a crashed sweep leaves a
+  valid table of everything that finished;
+* the **sweep manifest** (``sweep_manifest.json`` in the checkpoint
+  directory) — schema + config fingerprint (the per-scenario
+  ``engines.config_keys`` identities, same fingerprint machinery as
+  utils/checkpoint.py) + per-bucket status.  Completed buckets carry
+  their result rows; an in-flight bucket carries a CRC-verified state
+  snapshot (the stacked pytree + metric history + convergence masks),
+  persisted at chunk boundaries.
+
+Preemption contract (the solo engines' contract, extended per-bucket):
+``should_stop`` is polled between chunks; the in-flight chunk
+completes, the bucket's snapshot persists, and a ``--resume`` re-run
+skips completed buckets entirely and continues the interrupted bucket
+from its salvaged round — bitwise-identically, because the snapshot is
+the exact stacked state/topology and every fault/churn draw is keyed on
+``(seed, round, global id)``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from p2p_gossipprotocol_tpu.config import ConfigError
+from p2p_gossipprotocol_tpu.fleet.engine import (METRIC_KEYS, FleetBucket,
+                                                 stack_topologies)
+from p2p_gossipprotocol_tpu.fleet.packer import pack
+from p2p_gossipprotocol_tpu.fleet.spec import (ScenarioSpec,
+                                               build_scenarios,
+                                               parse_sweep_file)
+
+#: sweep manifest schema (independent of the solo checkpoint schema —
+#: the artifacts differ; the fingerprint/atomic-write/CRC machinery is
+#: shared from utils.checkpoint).
+SWEEP_SCHEMA = 1
+
+_STATE_LEAVES = ("seen_w", "frontier_w", "alive_b", "byz_w", "key",
+                 "round")
+
+
+@dataclass
+class SweepResult:
+    """Whole-sweep outcome.  ``results[i]`` is scenario i's SimResult,
+    or None when a resumed sweep skipped its already-completed bucket
+    (the row — the sweep's product — is still present in ``rows``)."""
+
+    rows: list[dict]
+    results: list
+    wall_s: float
+    n_buckets: int
+    n_scenarios: int
+    interrupted: bool = False
+    results_path: str | None = None
+
+
+@dataclass
+class FleetSweep:
+    """The ``engine=fleet`` entry registered in engines.build_simulator.
+
+    Holds the resolved scenarios and their bucket packing; :meth:`run`
+    drives the buckets and returns a :class:`SweepResult`."""
+
+    scenarios: list[ScenarioSpec]
+    buckets: list[list[int]]
+    target: float | None = None
+    results_path: str | None = None
+    _sim_cache: dict = field(default_factory=dict, repr=False)
+
+    @property
+    def n_scenarios(self) -> int:
+        return len(self.scenarios)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_config(cls, cfg, n_peers: int | None = None,
+                    clamps: list[str] | None = None,
+                    specs: list[dict] | None = None) -> "FleetSweep":
+        """Resolve the config's sweep into scenarios + buckets.  Raises
+        ValueError (the engine-table convention) for a missing spec
+        file or a bad sweep line."""
+        try:
+            if specs is None:
+                if not cfg.sweep_file:
+                    raise ValueError(
+                        "engine=fleet needs a sweep spec file "
+                        "(--sweep FILE, or the sweep_file= config key)")
+                specs = parse_sweep_file(cfg.sweep_file)
+            scenarios = build_scenarios(
+                cfg, specs, n_peers=n_peers,
+                pad_peers=bool(cfg.sweep_pad_peers))
+        except ConfigError as e:
+            raise ValueError(str(e)) from e
+        if clamps is not None:
+            for s in scenarios:
+                clamps.extend(f"[scenario {s.index}] {c}"
+                              for c in s.clamps)
+        buckets = pack([s.sim for s in scenarios],
+                       max_batch=cfg.sweep_max_batch or 256)
+        target = cfg.sweep_target if cfg.sweep_target > 0 else None
+        return cls(scenarios=scenarios, buckets=buckets, target=target,
+                   results_path=cfg.sweep_results or None)
+
+    # ------------------------------------------------------------------
+    def fingerprint(self) -> str:
+        """Config fingerprint of the WHOLE sweep: every scenario's
+        trajectory-determining identity (engines.config_keys) plus its
+        effective peer count — the resume guard."""
+        from p2p_gossipprotocol_tpu.engines import config_keys
+        from p2p_gossipprotocol_tpu.utils.checkpoint import \
+            config_fingerprint
+
+        return config_fingerprint({
+            "scenarios": [config_keys(s.cfg, n_peers=s.n_peers)
+                          for s in self.scenarios]})
+
+    def _bucket(self, b: int) -> FleetBucket:
+        if b not in self._sim_cache:
+            self._sim_cache[b] = FleetBucket(
+                [self.scenarios[i].sim for i in self.buckets[b]])
+        return self._sim_cache[b]
+
+    # -- per-bucket rows ------------------------------------------------
+    def _rows_for(self, b: int, bres, target: float | None) -> list[dict]:
+        rows = []
+        idx = self.buckets[b]
+        for j, i in enumerate(idx):
+            spec = self.scenarios[i]
+            res = bres.results[j]
+            row = {**spec.row_identity(), "engine": "fleet",
+                   "bucket": b, "bucket_size": len(idx),
+                   "rounds_run": int(bres.rounds_run[j]),
+                   "converged": bool(bres.converged[j]),
+                   "bucket_wall_s": round(bres.wall_s, 4),
+                   "wall_s_amortized": round(bres.wall_s / len(idx), 4)}
+            if len(res.coverage):
+                row["final_coverage"] = float(res.coverage[-1])
+                row["total_deliveries"] = int(round(
+                    float(res.deliveries.sum())))
+            if target is not None:
+                row[f"rounds_to_{target:g}"] = int(res.rounds_to(target))
+            rows.append(row)
+        return rows
+
+    # -- checkpoint plumbing --------------------------------------------
+    def _manifest_path(self, directory: str) -> str:
+        return os.path.join(directory, "sweep_manifest.json")
+
+    def _partial_path(self, directory: str, b: int) -> str:
+        return os.path.join(directory, f"fleet_bucket_{b}.npz")
+
+    def _persist_partial(self, directory: str, manifest: dict, b: int,
+                         state, topo, done, hist, rounds_done) -> None:
+        """Snapshot an in-flight bucket + commit the manifest (atomic
+        write AFTER the payload lands — the torn-write discipline of
+        utils.checkpoint)."""
+        import jax
+
+        from p2p_gossipprotocol_tpu.utils.checkpoint import (_crc_entry,
+                                                             _write_atomic)
+
+        payload = {f"state/{k}": np.asarray(
+            jax.device_get(getattr(state, k))) for k in _STATE_LEAVES}
+        if state.strikes is not None:
+            payload["state/strikes"] = np.asarray(
+                jax.device_get(state.strikes))
+        payload["topo/colidx"] = np.asarray(jax.device_get(topo.colidx))
+        payload["mask/done"] = np.asarray(jax.device_get(done))
+        for k, v in hist.items():
+            payload[f"hist/{k}"] = np.asarray(v)
+        path = self._partial_path(directory, b)
+        tmp = path + ".tmp.npz"
+        np.savez(tmp, **payload)
+        os.replace(tmp, path)
+        manifest["buckets"][str(b)] = {
+            "status": "partial", "rounds_done": int(rounds_done),
+            "leaves": {k: _crc_entry(v) for k, v in payload.items()},
+        }
+        _write_atomic(self._manifest_path(directory),
+                      json.dumps(manifest, sort_keys=True))
+
+    def _restore_partial(self, directory: str, manifest: dict, b: int):
+        """(state, topo, done, hist, rounds_done) of a salvaged bucket,
+        CRC-verified; raises CorruptCheckpoint naming the bad leaf."""
+        import jax.numpy as jnp
+
+        from p2p_gossipprotocol_tpu.aligned import AlignedState
+        from p2p_gossipprotocol_tpu.utils.checkpoint import (
+            CorruptCheckpoint, _crc_entry)
+
+        entry = manifest["buckets"][str(b)]
+        path = self._partial_path(directory, b)
+        try:
+            with np.load(path) as m:
+                payload = {k: m[k] for k in m.files}
+        except Exception as e:  # noqa: BLE001 — any unreadable snapshot
+            raise CorruptCheckpoint(
+                f"fleet bucket {b} snapshot is unreadable "
+                f"({type(e).__name__}: {e})") from e
+        for name, info in entry["leaves"].items():
+            if name not in payload:
+                raise CorruptCheckpoint(
+                    f"fleet bucket {b} snapshot is missing leaf "
+                    f"{name!r}")
+            got = _crc_entry(payload[name])
+            if got["crc32"] != info["crc32"]:
+                raise CorruptCheckpoint(
+                    f"CRC mismatch in fleet bucket {b} leaf {name!r}")
+        bucket = self._bucket(b)
+        state = AlignedState(
+            **{k: jnp.asarray(payload[f"state/{k}"])
+               for k in _STATE_LEAVES},
+            strikes=(jnp.asarray(payload["state/strikes"])
+                     if "state/strikes" in payload else None))
+        # statics + immutable tables rebuild deterministically from the
+        # scenario seeds; only the rewired lane tables carry history
+        topo = stack_topologies(
+            [self.scenarios[i].sim.topo for i in self.buckets[b]],
+            bucket.template.topo).replace(
+                colidx=jnp.asarray(payload["topo/colidx"]))
+        done = jnp.asarray(payload["mask/done"])
+        hist = {k: payload[f"hist/{k}"] for k in METRIC_KEYS}
+        hist["_converged_round"] = payload["hist/_converged_round"]
+        return state, topo, done, hist, int(entry["rounds_done"])
+
+    def _write_rows(self, rows: list[dict]) -> None:
+        if not self.results_path:
+            return
+        from p2p_gossipprotocol_tpu.utils.checkpoint import _write_atomic
+
+        _write_atomic(self.results_path,
+                      "".join(json.dumps(r) + "\n" for r in rows))
+
+    # ------------------------------------------------------------------
+    def run(self, rounds: int, target: float | None = None,
+            check_every: int = 8, checkpoint_dir: str | None = None,
+            checkpoint_every: int = 0, resume: bool = False,
+            should_stop=None, log=None) -> SweepResult:
+        """Serve every bucket; returns the sweep's rows + results.
+
+        ``target`` (default: the config's ``sweep_target``) switches on
+        convergence masking + bucket early-exit; None runs each bucket
+        for exactly ``rounds`` lockstep rounds.  With
+        ``checkpoint_dir``, completed buckets and the in-flight
+        bucket's snapshot persist as described in the module docstring;
+        ``resume=True`` continues from them."""
+        import time
+
+        from p2p_gossipprotocol_tpu.utils.checkpoint import (
+            CheckpointError, FingerprintMismatch, _write_atomic)
+
+        target = self.target if target is None else target
+        fp = self.fingerprint()
+        manifest = {"schema": SWEEP_SCHEMA, "fingerprint": fp,
+                    "n_scenarios": len(self.scenarios),
+                    "n_buckets": len(self.buckets), "buckets": {}}
+        if checkpoint_dir:
+            os.makedirs(checkpoint_dir, exist_ok=True)
+            mpath = self._manifest_path(checkpoint_dir)
+            if resume:
+                if not os.path.exists(mpath):
+                    raise CheckpointError(
+                        f"sweep resume requested but {checkpoint_dir!r} "
+                        "holds no sweep_manifest.json — refusing to "
+                        "silently start over")
+                with open(mpath) as f:
+                    old = json.load(f)
+                if int(old.get("schema", 0)) > SWEEP_SCHEMA:
+                    raise CheckpointError(
+                        f"sweep manifest schema {old.get('schema')} is "
+                        f"newer than this build's {SWEEP_SCHEMA}")
+                if old.get("fingerprint") != fp:
+                    raise FingerprintMismatch(
+                        "sweep checkpoint was written under fingerprint "
+                        f"{old.get('fingerprint')}, this sweep "
+                        f"fingerprints as {fp} — resume with the "
+                        "original specs, or point --checkpoint-dir at "
+                        "a fresh directory")
+                manifest["buckets"] = old.get("buckets", {})
+
+        rows: list[dict] = []
+        results: list = [None] * len(self.scenarios)
+        interrupted = False
+        t0 = time.perf_counter()
+        for b in range(len(self.buckets)):
+            entry = manifest["buckets"].get(str(b))
+            if entry and entry.get("status") == "done":
+                rows.extend(entry["rows"])      # already served
+                if log:
+                    log(f"[fleet] bucket {b}: resumed as complete "
+                        f"({len(self.buckets[b])} scenarios)")
+                continue
+            if should_stop is not None and should_stop():
+                interrupted = True
+                break
+            bucket = self._bucket(b)
+            kw: dict = {}
+            if entry and entry.get("status") == "partial" \
+                    and checkpoint_dir:
+                state, topo, done, hist, done_r = self._restore_partial(
+                    checkpoint_dir, manifest, b)
+                if done_r > rounds:
+                    raise CheckpointError(
+                        f"fleet bucket {b} checkpoint already contains "
+                        f"{done_r} rounds > the requested {rounds} — "
+                        f"re-run with rounds >= {done_r}")
+                kw = dict(state=state, topo=topo, done=done, hist=hist,
+                          rounds_done=done_r)
+                if log:
+                    log(f"[fleet] bucket {b}: resuming at round "
+                        f"{done_r}")
+            after_chunk = None
+            if checkpoint_dir:
+                last_saved = [kw.get("rounds_done", 0)]
+
+                def after_chunk(state, topo, done, hist, done_r,
+                                b=b, last_saved=last_saved):
+                    due = (checkpoint_every > 0
+                           and done_r - last_saved[0] >= checkpoint_every)
+                    stopping = should_stop is not None and should_stop()
+                    if due or stopping:
+                        self._persist_partial(checkpoint_dir, manifest,
+                                              b, state, topo, done,
+                                              hist, done_r)
+                        last_saved[0] = done_r
+            bres = bucket.run(rounds, target=target,
+                              check_every=check_every,
+                              should_stop=should_stop,
+                              after_chunk=after_chunk, **kw)
+            if bres.interrupted:
+                interrupted = True
+                break
+            brows = self._rows_for(b, bres, target)
+            rows.extend(brows)
+            for j, i in enumerate(self.buckets[b]):
+                results[i] = bres.results[j]
+            if log:
+                n_conv = int(bres.converged.sum())
+                log(f"[fleet] bucket {b}: {len(self.buckets[b])} "
+                    f"scenarios, {int(bres.rounds_run.max())} rounds, "
+                    f"{n_conv} converged, {bres.wall_s:.2f}s")
+            self._write_rows(rows)
+            if checkpoint_dir:
+                manifest["buckets"][str(b)] = {"status": "done",
+                                               "rows": brows}
+                _write_atomic(self._manifest_path(checkpoint_dir),
+                              json.dumps(manifest, sort_keys=True))
+                try:
+                    os.remove(self._partial_path(checkpoint_dir, b))
+                except OSError:
+                    pass
+        wall = time.perf_counter() - t0
+        return SweepResult(rows=rows, results=results, wall_s=wall,
+                           n_buckets=len(self.buckets),
+                           n_scenarios=len(self.scenarios),
+                           interrupted=interrupted,
+                           results_path=self.results_path)

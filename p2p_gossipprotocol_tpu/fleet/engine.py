@@ -1,0 +1,281 @@
+"""Batched multi-scenario engine: one vmapped round for a whole bucket.
+
+The bucket's scenarios share one compiled program (packer.py's
+signature contract); their states and topology tables stack on a
+leading scenario axis and :func:`aligned.aligned_round` — THE round
+implementation every aligned engine shares — runs under ``jax.vmap``
+with per-scenario overrides for the two seed-derived inputs the solo
+engine reads as statics (the liveness hash seed and the staggered
+message-source table).  Everything else that is per-scenario already
+flows through arrays: the PRNG chain (``state.key``), the byzantine
+draw (``state.byz_w``), the overlay tables, and the fault gates (keyed
+on ``(plan-seed, round, global id)``, identical solo or batched).
+
+Convergence masking + bucket early-exit: the lockstep scan checks every
+scenario's census coverage EVERY round (the done flags live on-device,
+so per-round checking costs no host sync — unlike the solo engine's
+check_every barrier amortization) and freezes a converged scenario's
+state/topology in place, so its recorded trajectory ends at its exact
+convergence round while stragglers run on.  The host loop polls the
+done flags once per ``check_every``-round chunk and stops the bucket as
+soon as every scenario has converged.
+
+Bitwise contract (tests/test_fleet.py): scenario ``i``'s unpacked
+``SimResult`` — state, mutated topology, and every per-round metric —
+is bit-identical to ``sims[i].run(rounds_i)`` on the solo engine.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from p2p_gossipprotocol_tpu.aligned import (ALIGNED_TOPO_LEAVES,
+                                            AlignedTopology, aligned_round)
+from p2p_gossipprotocol_tpu.fleet.packer import bucket_signature
+from p2p_gossipprotocol_tpu.state import stagger_sched_end
+
+#: metric keys of aligned_round's census dict, in emission order, with
+#: the dtype each arrives in from the solo engine's scan (evictions is
+#: the one int — the rest ride the exact-popcount-pair float32 path).
+#: The unpacked histories keep these dtypes so a fleet SimResult is
+#: indistinguishable from a solo one, array dtypes included.
+METRIC_DTYPES = {"coverage": np.float32, "deliveries": np.float32,
+                 "frontier_size": np.float32, "live_peers": np.float32,
+                 "evictions": np.int32, "redeliveries": np.float32}
+METRIC_KEYS = tuple(METRIC_DTYPES)
+
+
+def stack_topologies(topos: list[AlignedTopology],
+                     template: AlignedTopology) -> AlignedTopology:
+    """One AlignedTopology whose array leaves carry a leading scenario
+    axis; static fields come from the template (none of them is read by
+    the round itself — ``rows`` derives from the leaf shapes, which are
+    per-scenario inside the vmap)."""
+    kw = {k: jnp.stack([getattr(t, k) for t in topos])
+          for k in ALIGNED_TOPO_LEAVES}
+    ytab = (None if template.ytab is None
+            else jnp.stack([t.ytab for t in topos]))
+    return AlignedTopology(**kw, ytab=ytab, n_peers=template.n_peers,
+                           n_slots=template.n_slots,
+                           rowblk=template.rowblk,
+                           roll_groups=template.roll_groups,
+                           reuse_leak=template.reuse_leak)
+
+
+def _unstack_topology(btopo: AlignedTopology, i: int,
+                      solo: AlignedTopology) -> AlignedTopology:
+    """Scenario ``i``'s slice of the batched topology, carrying ITS solo
+    statics back (n_peers differs per scenario within a bucket)."""
+    kw = {k: getattr(btopo, k)[i] for k in ALIGNED_TOPO_LEAVES}
+    return AlignedTopology(**kw,
+                           ytab=(None if btopo.ytab is None
+                                 else btopo.ytab[i]),
+                           n_peers=solo.n_peers, n_slots=solo.n_slots,
+                           rowblk=solo.rowblk,
+                           roll_groups=solo.roll_groups,
+                           reuse_leak=solo.reuse_leak)
+
+
+def _freeze(done, old, new):
+    """Per-leaf select: a done scenario keeps its frozen value."""
+    d = done.reshape(done.shape + (1,) * (new.ndim - 1))
+    return jnp.where(d, old, new)
+
+
+@dataclass
+class BucketResult:
+    """One bucket's unpacked outcome.
+
+    ``results[i]`` is scenario i's :class:`sim.SimResult` covering
+    rounds ``[0, rounds_run[i])`` — its history truncated at its own
+    convergence round, bitwise-equal to the solo engine's.  ``wall_s``
+    is the BUCKET's wall-clock (shared by every scenario it served —
+    the whole point of batching); per-scenario attribution is
+    ``wall_s / len(results)``."""
+
+    results: list                      # list[sim.SimResult]
+    rounds_run: np.ndarray             # int32[B] rounds each scenario ran
+    converged: np.ndarray              # bool [B] reached the target
+    wall_s: float = 0.0
+    interrupted: bool = False          # should_stop fired mid-bucket
+
+
+@dataclass
+class FleetBucket:
+    """A signature-identical scenario batch, runnable as one program.
+
+    ``sims`` are the exact solo simulators (spec.py builds them through
+    ``AlignedSimulator.from_config``, the same path the CLI takes) —
+    the bucket only ever *batches* them, never rebuilds or reshapes
+    them, which is what makes the bitwise-parity contract provable.
+    """
+
+    sims: list                         # list[AlignedSimulator]
+    _chunk_cache: dict = field(default_factory=dict, repr=False)
+
+    def __post_init__(self):
+        if not self.sims:
+            raise ValueError("a fleet bucket needs at least one scenario")
+        sig = bucket_signature(self.sims[0])
+        for s in self.sims[1:]:
+            if bucket_signature(s) != sig:
+                raise ValueError(
+                    "fleet bucket scenarios must share one program "
+                    "signature (packer.pack groups them)")
+        self.template = self.sims[0]
+        self._seeds = jnp.asarray([s.seed for s in self.sims], jnp.int32)
+        # staggered-generation source tables (per-scenario: the plan is
+        # seed- and byzantine-derived); harmless constants when stagger
+        # is off (aligned_round never touches them then)
+        if self.template.message_stagger > 0:
+            self._srcs = jnp.stack(
+                [s._message_plan()[1] for s in self.sims])
+        else:
+            self._srcs = jnp.zeros((len(self.sims), 1), jnp.int32)
+        self._sched_end = stagger_sched_end(
+            self.template._n_honest, self.template.message_stagger)
+
+    @property
+    def size(self) -> int:
+        return len(self.sims)
+
+    # ------------------------------------------------------------------
+    def init(self):
+        """(bstate, btopo): every scenario's solo init_state / topology,
+        stacked — bit-identical per scenario by construction."""
+        bstate = jax.tree.map(lambda *xs: jnp.stack(xs),
+                              *[s.init_state() for s in self.sims])
+        btopo = stack_topologies([s.topo for s in self.sims],
+                                 self.template.topo)
+        return bstate, btopo
+
+    # ------------------------------------------------------------------
+    def _chunk_fn(self, length: int, target: float | None):
+        """Compiled ``length``-round lockstep chunk with in-scan
+        convergence masking; cached per (length, target)."""
+        key = (length, target)
+        if key in self._chunk_cache:
+            return self._chunk_cache[key]
+        tmpl = self.template
+        sched_end = self._sched_end
+
+        def one(state, topo, seed, srcs):
+            grows = jnp.arange(topo.rows, dtype=jnp.int32)
+            return aligned_round(
+                tmpl, state, topo, grows=grows, t_off=jnp.int32(0),
+                gather=lambda x: x, reduce=lambda x: x,
+                hash_seed=seed, msg_srcs=srcs)
+
+        vstep = jax.vmap(one)
+
+        def chunk(bstate, btopo, done, seeds, srcs):
+            def body(carry, _):
+                bs, bt, dn = carry
+                ns, nt, m = vstep(bs, bt, seeds, srcs)
+                # convergence masking: a done scenario's world is
+                # frozen (state, PRNG chain, rewired lane tables), so
+                # its trajectory ends at its exact convergence round.
+                # With no target the mask is all-False and the select
+                # is the identity — the fixed-round path compiles to
+                # the same values the solo scan produces.
+                ns = jax.tree.map(lambda o, n: _freeze(dn, o, n), bs, ns)
+                nt = jax.tree.map(lambda o, n: _freeze(dn, o, n), bt, nt)
+                if target is not None:
+                    # solo run_to_coverage's stop condition, per
+                    # scenario: census coverage at target AND the
+                    # stagger schedule fully emitted.
+                    dn = dn | ((m["coverage"] >= target)
+                               & (ns.round >= sched_end))
+                return (ns, nt, dn), (m, dn)
+
+            (bs, bt, dn), (ys, dhist) = jax.lax.scan(
+                body, (bstate, btopo, done), None, length=length)
+            return bs, bt, dn, ys, dhist
+
+        fn = jax.jit(chunk)
+        self._chunk_cache[key] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    def run(self, rounds: int, target: float | None = None,
+            check_every: int = 8, state=None, topo=None, done=None,
+            hist: dict | None = None, rounds_done: int = 0,
+            should_stop=None, after_chunk=None) -> BucketResult:
+        """Serve the whole bucket for up to ``rounds`` rounds.
+
+        ``target`` enables convergence masking + early exit: the bucket
+        stops at the first chunk boundary where EVERY scenario has
+        converged (each at its own exact round — the masking is
+        per-round, on-device).  ``target=None`` runs the fixed-round
+        lockstep scan, the bitwise twin of every solo ``run(rounds)``.
+
+        ``state``/``topo``/``done``/``hist``/``rounds_done`` resume a
+        salvaged bucket mid-flight (driver.py persists them);
+        ``should_stop`` is polled between chunks and ``after_chunk``
+        receives ``(bstate, btopo, done, hist, rounds_done)`` after
+        each chunk — the checkpoint seam.
+        """
+        if check_every < 1:
+            raise ValueError("check_every must be >= 1")
+        from p2p_gossipprotocol_tpu.sim import SimResult
+
+        B = self.size
+        if state is None or topo is None:
+            state, topo = self.init()
+        if done is None:
+            done = jnp.zeros(B, bool)
+        hist = dict(hist) if hist else {
+            k: np.zeros((0, B), dt) for k, dt in METRIC_DTYPES.items()}
+        conv = hist.pop("_converged_round", np.zeros(B, np.int64) - 1)
+        conv = np.asarray(conv, np.int64)
+        t0 = time.perf_counter()
+        interrupted = False
+        while rounds_done < rounds:
+            if should_stop is not None and should_stop():
+                interrupted = True
+                break
+            if target is not None and bool(np.asarray(
+                    jax.device_get(done)).all()):
+                break                      # bucket early-exit
+            step = min(check_every, rounds - rounds_done)
+            fn = self._chunk_fn(step, target)
+            state, topo, done, ys, dhist = fn(state, topo, done,
+                                              self._seeds, self._srcs)
+            ys = {k: np.asarray(jax.device_get(ys[k]))
+                  for k in METRIC_KEYS}
+            dh = np.asarray(jax.device_get(dhist))       # [step, B] bool
+            hist = {k: np.concatenate([hist[k], ys[k]]) for k in ys}
+            # first round (1-indexed, global) each scenario converged
+            for j in range(step):
+                newly = dh[j] & (conv < 0)
+                conv[newly] = rounds_done + j + 1
+            rounds_done += step
+            if after_chunk is not None:
+                after_chunk(state, topo, done,
+                            {**hist, "_converged_round": conv},
+                            rounds_done)
+        # ensure completion before reading the clock (device_get above
+        # already synchronizes each chunk; this is the zero-chunk case)
+        jax.block_until_ready(state.round)
+        wall = time.perf_counter() - t0
+
+        converged = conv > 0
+        rounds_run = np.where(converged, conv, rounds_done).astype(
+            np.int64)
+        results = []
+        for i, solo in enumerate(self.sims):
+            r_i = int(rounds_run[i])
+            st_i = jax.tree.map(lambda x: x[i], state)
+            tp_i = _unstack_topology(topo, i, solo.topo)
+            results.append(SimResult(
+                state=st_i, topo=tp_i, wall_s=wall,
+                **{k: hist[k][:r_i, i] for k in METRIC_KEYS}))
+        return BucketResult(results=results, rounds_run=rounds_run,
+                            converged=converged, wall_s=wall,
+                            interrupted=interrupted)

@@ -31,21 +31,36 @@ def emit_jsonl(rows: Iterable[Mapping], fp: IO[str], **common) -> int:
     return n
 
 
+def _count(x) -> int:
+    """Host-side cast of a count metric back to int.  The aligned
+    engines emit counts as float32 (the exact [hi, lo] popcount pair
+    combines to float so totals past 2^31 bits don't wrap —
+    aligned._pair_total), and a bare ``int()`` TRUNCATES: beyond 2^24
+    the nearest-representable float32 of an exact integer can sit just
+    below it, so truncation walks counts down.  ``round()`` is exact
+    within the documented ±4-peer error of the pair-to-float step
+    (docs/PARITY.md, metric contract)."""
+    return int(round(float(x)))
+
+
 def rows_from_result(res) -> list[dict]:
     """Per-round rows from a sim.SimResult (or anything exposing the same
-    metric arrays)."""
+    metric arrays).  Count metrics are cast back to int host-side
+    (:func:`_count`) so the JSONL rows read as the integers they are,
+    whichever engine (int32 edges / float32 aligned census) produced
+    them."""
     redel = getattr(res, "redeliveries", None)
     out = []
     for i in range(len(res.coverage)):
         row = {
             "coverage": float(res.coverage[i]),
-            "deliveries": int(res.deliveries[i]),
-            "frontier_size": int(res.frontier_size[i]),
-            "live_peers": int(res.live_peers[i]),
-            "evictions": int(res.evictions[i]),
+            "deliveries": _count(res.deliveries[i]),
+            "frontier_size": _count(res.frontier_size[i]),
+            "live_peers": _count(res.live_peers[i]),
+            "evictions": _count(res.evictions[i]),
         }
         if redel is not None:
-            row["redeliveries"] = int(redel[i])
+            row["redeliveries"] = _count(redel[i])
         out.append(row)
     return out
 
@@ -56,7 +71,7 @@ def summarize(res, target: float = 0.99) -> dict:
         "rounds": int(len(res.coverage)),
         "final_coverage": float(res.coverage[-1]),
         f"rounds_to_{target:g}": int(res.rounds_to(target)),
-        "total_deliveries": int(res.deliveries.sum()),
+        "total_deliveries": _count(res.deliveries.sum()),
         "wall_s": float(res.wall_s),
         "msgs_per_sec": (float(res.deliveries.sum() / res.wall_s)
                          if res.wall_s else 0.0),

@@ -67,6 +67,16 @@ class Peer:
 
             self._sim, self.engine = build_simulator(
                 cfg, clamps=self.clamps)
+            if self.engine == "fleet":
+                # the facade models ONE reference peer's view of ONE
+                # network; a multi-scenario sweep has no single-peer
+                # analogue — drive sweeps through the CLI (--sweep) or
+                # fleet.FleetSweep directly
+                raise ValueError(
+                    "engine=fleet (multi-scenario sweeps) is not "
+                    "reachable through the wrapper.Peer facade — use "
+                    "the CLI's --sweep path or "
+                    "p2p_gossipprotocol_tpu.fleet.FleetSweep")
             self._running = False
             self._stop_event = threading.Event()
             self.rounds_completed = 0   # chunks landed so far (jax)

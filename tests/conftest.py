@@ -29,21 +29,38 @@ import pytest  # noqa: E402
 # fresh checkout would silently skip the 13 native tests even on a
 # machine with a full toolchain.  One quiet make at collection time
 # keeps those tests live; failure (no g++, no make) falls back to the
-# skipif guards exactly as before.
+# skipif guards exactly as before.  Gated on the .so being absent or
+# older than the native sources — single-test runs on an up-to-date
+# tree must not pay the 120 s-timeout subprocess at every collection.
+
+
+def _native_stale(native_dir: str) -> bool:
+    so = os.path.join(native_dir, "libgossip_native.so")
+    if not os.path.exists(so):
+        return True
+    built = os.path.getmtime(so)
+    for src in ("gossip_native.cpp", "Makefile"):
+        p = os.path.join(native_dir, src)
+        if os.path.exists(p) and os.path.getmtime(p) > built:
+            return True
+    return False
+
+
 try:
     import subprocess
     import warnings
 
-    _mk = subprocess.run(
-        ["make", "-C",
-         os.path.join(os.path.dirname(os.path.dirname(
-             os.path.abspath(__file__))), "native")],
-        capture_output=True, timeout=120, check=False, text=True)
-    if _mk.returncode != 0:
-        # A toolchain exists but the build BROKE — that must be loud,
-        # not a green suite with 13 silent skips.
-        warnings.warn("native build failed (tests will skip): "
-                      + _mk.stderr.strip()[-500:], stacklevel=1)
+    _native_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native")
+    if _native_stale(_native_dir):
+        _mk = subprocess.run(
+            ["make", "-C", _native_dir],
+            capture_output=True, timeout=120, check=False, text=True)
+        if _mk.returncode != 0:
+            # A toolchain exists but the build BROKE — that must be
+            # loud, not a green suite with 13 silent skips.
+            warnings.warn("native build failed (tests will skip): "
+                          + _mk.stderr.strip()[-500:], stacklevel=1)
 except Exception:  # noqa: BLE001 — no toolchain: tests skip gracefully
     pass
 

@@ -101,6 +101,52 @@ def test_graft_entry_main_is_hang_proof():
     assert "dryrun_multichip(2) OK" in proc.stdout
 
 
+def test_probe_gate_skips_when_no_plugin_marker(monkeypatch):
+    """A plain CPU box — no PALLAS_AXON_POOL_IPS, no installed TPU
+    plugin, no jax_plugins entry point — must skip the subprocess probe
+    entirely (zero import latency), even without a JAX_PLATFORMS pin.
+    Unit-level because this container HAS libtpu installed: the marker
+    detector is stubbed to the plain-box answer and the subprocess seam
+    is armed to fail the test if the probe still runs."""
+    from p2p_gossipprotocol_tpu import engines
+
+    monkeypatch.delenv("GOSSIP_NO_BACKEND_PROBE", raising=False)
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setattr(engines, "_plugin_marker_present", lambda: False)
+
+    def _no_probe(*a, **k):
+        raise AssertionError("subprocess probe ran on a plain CPU box")
+
+    monkeypatch.setattr(engines.subprocess, "run", _no_probe)
+    saved = engines._PROBE_STATE[:]
+    engines._PROBE_STATE.clear()
+    try:
+        assert engines.probe_backend() is False
+    finally:
+        engines._PROBE_STATE[:] = saved
+
+
+def test_plugin_marker_detection(monkeypatch):
+    """The marker detector: the tunneled-plugin env var alone marks a
+    plugin present; a detection failure answers True (when we cannot
+    tell, keep the hang-proof probe)."""
+    import importlib.util
+
+    from p2p_gossipprotocol_tpu import engines
+
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    assert engines._plugin_marker_present() is True
+
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+
+    def _boom(name):
+        raise RuntimeError("detector broke")
+
+    monkeypatch.setattr(importlib.util, "find_spec", _boom)
+    assert engines._plugin_marker_present() is True
+
+
 def test_probe_opt_out():
     """GOSSIP_NO_BACKEND_PROBE=1 skips the probe entirely (no fallback
     message even with an impossible timeout)."""

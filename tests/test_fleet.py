@@ -1,0 +1,272 @@
+"""Fleet engine: batched multi-scenario serving (fleet/).
+
+The load-bearing contract: every scenario in a mixed-bucket sweep
+produces a result **bitwise-identical** to its solo AlignedSimulator
+run — state, mutated topology, and every per-round metric.  Batching
+must never correlate what should be independent experiments, and the
+packer's shape bucketing must never alter a scenario's trajectory.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from p2p_gossipprotocol_tpu.config import ConfigError, NetworkConfig
+from p2p_gossipprotocol_tpu.fleet import (FleetBucket, FleetSweep,
+                                          build_scenarios, pack)
+from p2p_gossipprotocol_tpu.fleet.engine import METRIC_KEYS
+from p2p_gossipprotocol_tpu.fleet.packer import bucket_signature
+
+BASE_CFG = """\
+127.0.0.1:8000
+backend=jax
+engine=fleet
+n_peers=1024
+n_messages=16
+avg_degree=8
+rounds=6
+"""
+
+#: seeds x modes x fault plans x churn x byzantine x stagger — a
+#: heterogeneous sweep that exercises every per-scenario seam the
+#: batched round has (PRNG chains, liveness hash seeds, fault gates,
+#: byzantine planes, staggered source tables, padded peer counts).
+MIXED_SPECS = [
+    {"prng_seed": 0, "churn_rate": 0.05},
+    {"prng_seed": 2, "churn_rate": 0.05, "n_peers": 1000},
+    {"prng_seed": 0, "mode": "pull"},
+    {"prng_seed": 3, "mode": "pull"},
+    {"prng_seed": 4, "mode": "pushpull", "fault_link_drop": 0.2,
+     "fault_partition": "1:4", "fault_seed": 7},
+    {"prng_seed": 5, "mode": "pushpull", "fault_link_drop": 0.2,
+     "fault_partition": "1:4", "fault_seed": 7},
+    {"prng_seed": 6, "byzantine_fraction": 0.1},
+    {"prng_seed": 7, "message_stagger": 2},
+]
+
+
+@pytest.fixture(scope="module")
+def base_cfg(tmp_path_factory):
+    p = tmp_path_factory.mktemp("fleet") / "network.txt"
+    p.write_text(BASE_CFG)
+    return NetworkConfig(str(p))
+
+
+@pytest.fixture(scope="module")
+def mixed(base_cfg):
+    """(scenarios, buckets, fleet_results_by_scenario) for MIXED_SPECS,
+    run fixed-rounds (no masking) — the pure bitwise-parity setting."""
+    scenarios = build_scenarios(base_cfg, MIXED_SPECS)
+    buckets = pack([s.sim for s in scenarios])
+    results = [None] * len(scenarios)
+    for idx in buckets:
+        bres = FleetBucket([scenarios[i].sim for i in idx]).run(6)
+        for j, i in enumerate(idx):
+            results[i] = bres.results[j]
+    return scenarios, buckets, results
+
+
+def _assert_bitwise(fleet_res, solo_res, what):
+    for k in METRIC_KEYS:
+        f, s = getattr(fleet_res, k), getattr(solo_res, k)
+        assert np.array_equal(f, s), (what, k, f, s)
+    for k in ("seen_w", "frontier_w", "alive_b", "byz_w", "round",
+              "key"):
+        f = np.asarray(jax.device_get(getattr(fleet_res.state, k)))
+        s = np.asarray(jax.device_get(getattr(solo_res.state, k)))
+        assert np.array_equal(f, s), (what, "state." + k)
+    fs, ss = fleet_res.state.strikes, solo_res.state.strikes
+    assert (fs is None) == (ss is None), (what, "strikes presence")
+    if fs is not None:
+        assert np.array_equal(np.asarray(jax.device_get(fs)),
+                              np.asarray(jax.device_get(ss)))
+    assert np.array_equal(
+        np.asarray(jax.device_get(fleet_res.topo.colidx)),
+        np.asarray(jax.device_get(solo_res.topo.colidx))), (
+            what, "topo.colidx")
+
+
+def test_mixed_bucket_bitwise_parity(mixed):
+    """Every scenario of the mixed sweep — seeds x modes x fault plans
+    x churn x byzantine x stagger, batched into shape buckets — is
+    bitwise-identical to its solo AlignedSimulator run."""
+    scenarios, buckets, results = mixed
+    assert 1 < len(buckets) < len(scenarios)   # genuinely mixed buckets
+    for s, fres in zip(scenarios, results):
+        solo = s.sim.run(6)
+        _assert_bitwise(fres, solo, f"scenario {s.index}")
+
+
+def test_mixed_bucketing_shape(mixed):
+    """The packer groups exactly the signature-identical scenarios:
+    same-family seeds batch together (incl. the padded n_peers=1000
+    line), and each distinct mode/fault/byz/stagger family gets its own
+    bucket."""
+    scenarios, buckets, _ = mixed
+    sizes = sorted(len(b) for b in buckets)
+    assert sizes == [1, 1, 2, 2, 2]
+    # the churn family holds seeds 0,2 — including the padded 1000
+    assert buckets[0] == [0, 1]
+    assert scenarios[1].n_peers == 1024
+    assert scenarios[1].n_peers_requested == 1000
+
+
+def test_convergence_masking_matches_solo_prefix(base_cfg):
+    """With a coverage target, a converged scenario freezes at its own
+    exact convergence round while stragglers run on — and its truncated
+    history/state equal a solo run of exactly that many rounds."""
+    scenarios = build_scenarios(
+        base_cfg, [{"prng_seed": s} for s in range(3)])
+    bucket = FleetBucket([s.sim for s in scenarios])
+    bres = bucket.run(32, target=0.99, check_every=4)
+    assert bres.converged.all()
+    assert (bres.rounds_run < 32).all()
+    for j, s in enumerate(scenarios):
+        r = int(bres.rounds_run[j])
+        assert len(bres.results[j].coverage) == r
+        assert bres.results[j].coverage[-1] >= 0.99
+        solo = s.sim.run(r)
+        _assert_bitwise(bres.results[j], solo, f"scenario {j} @ {r}")
+
+
+def test_all_converged_early_exit(base_cfg):
+    """Bucket early-exit: when every scenario converges, the bucket
+    stops at the next chunk boundary instead of serving the full round
+    budget (the recorded histories end at the convergence rounds)."""
+    scenarios = build_scenarios(base_cfg,
+                                [{"prng_seed": 0}, {"prng_seed": 1}])
+    bucket = FleetBucket([s.sim for s in scenarios])
+    bres = bucket.run(128, target=0.5, check_every=4)
+    assert bres.converged.all()
+    assert bres.rounds_run.max() <= 8      # not the 128-round budget
+    for j in range(2):
+        assert len(bres.results[j].coverage) == int(bres.rounds_run[j])
+
+
+def test_single_scenario_bucket(base_cfg):
+    """Packer edge case: one scenario is one bucket of one, and the
+    batched machinery still reproduces the solo run bitwise."""
+    scenarios = build_scenarios(base_cfg, [{"prng_seed": 9}])
+    buckets = pack([s.sim for s in scenarios])
+    assert buckets == [[0]]
+    bres = FleetBucket([scenarios[0].sim]).run(5)
+    _assert_bitwise(bres.results[0], scenarios[0].sim.run(5), "single")
+
+
+def test_bucket_overflow_splits(base_cfg):
+    """Packer edge case: a signature group larger than max_batch splits
+    into successive buckets, order preserved."""
+    scenarios = build_scenarios(
+        base_cfg, [{"prng_seed": s} for s in range(5)])
+    sims = [s.sim for s in scenarios]
+    assert pack(sims, max_batch=2) == [[0, 1], [2, 3], [4]]
+    assert pack(sims, max_batch=8) == [[0, 1, 2, 3, 4]]
+    sig = bucket_signature(sims[0])
+    assert all(bucket_signature(s) == sig for s in sims)
+
+
+def test_unknown_sweep_key_is_an_error(base_cfg):
+    with pytest.raises(ConfigError, match="unknown or reserved"):
+        build_scenarios(base_cfg, [{"prng_sed": 3}])
+
+
+def test_sir_scenario_is_a_named_error(base_cfg):
+    with pytest.raises(ConfigError, match="push/pull/pushpull"):
+        build_scenarios(base_cfg, [{"mode": "sir"}])
+
+
+def test_sweep_resume_is_bitwise(base_cfg, tmp_path):
+    """Preemption salvage: a sweep stopped mid-flight (after its first
+    bucket, then mid-bucket via chunk checkpoints) resumes per-bucket
+    and finishes with rows identical to an uninterrupted sweep's."""
+    specs = [{"prng_seed": 0}, {"prng_seed": 1},
+             {"prng_seed": 2, "mode": "pull"}]
+
+    def mk():
+        sweep = FleetSweep.from_config(base_cfg, specs=specs)
+        sweep.results_path = None
+        return sweep
+
+    ref = mk().run(8, target=0.99, check_every=2)
+    assert not ref.interrupted and len(ref.rows) == 3
+
+    ck = str(tmp_path / "ck")
+    calls = {"n": 0}
+
+    def stop_after_two():
+        calls["n"] += 1
+        return calls["n"] > 2
+
+    partial = mk().run(8, target=0.99, check_every=2,
+                       checkpoint_dir=ck, checkpoint_every=2,
+                       should_stop=stop_after_two)
+    assert partial.interrupted
+    assert os.path.exists(os.path.join(ck, "sweep_manifest.json"))
+
+    resumed = mk().run(8, target=0.99, check_every=2,
+                       checkpoint_dir=ck, resume=True)
+    assert not resumed.interrupted
+
+    def strip(rows):
+        drop = ("bucket_wall_s", "wall_s_amortized")
+        return [{k: v for k, v in r.items() if k not in drop}
+                for r in sorted(rows, key=lambda r: r["scenario"])]
+
+    assert strip(resumed.rows) == strip(ref.rows)
+
+
+def test_sweep_resume_refuses_fingerprint_drift(base_cfg, tmp_path):
+    from p2p_gossipprotocol_tpu.utils.checkpoint import \
+        FingerprintMismatch
+
+    ck = str(tmp_path / "ck")
+    sweep = FleetSweep.from_config(base_cfg, specs=[{"prng_seed": 0}])
+    sweep.results_path = None
+    sweep.run(4, target=None, checkpoint_dir=ck)
+    drifted = FleetSweep.from_config(base_cfg, specs=[{"prng_seed": 1}])
+    drifted.results_path = None
+    with pytest.raises(FingerprintMismatch):
+        drifted.run(4, target=None, checkpoint_dir=ck, resume=True)
+
+
+def test_cli_sweep_end_to_end(base_cfg, tmp_path):
+    """CLI surface: --sweep serves the sweep, writes the JSONL results
+    table, and prints the fleet summary line."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sweep_file = tmp_path / "sweep.jsonl"
+    sweep_file.write_text('{"prng_seed": 0}\n'
+                          '# a comment\n'
+                          '{"prng_seed": 1, "n_peers": 1000}\n')
+    rows_file = tmp_path / "rows.jsonl"
+    proc = subprocess.run(
+        [sys.executable, "-m", "p2p_gossipprotocol_tpu.cli",
+         base_cfg.config_file_path, "--sweep", str(sweep_file),
+         "--sweep-results", str(rows_file), "--rounds", "8", "--quiet"],
+        capture_output=True, text=True, timeout=300,
+        env={"PYTHONPATH": repo, "JAX_PLATFORMS": "cpu",
+             "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+             "HOME": os.environ.get("HOME", "/root")}, cwd=repo)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["engine"] == "fleet"
+    assert summary["n_scenarios"] == 2
+    assert summary["n_buckets"] == 1       # 1000 pads to 1024, batches
+    rows = [json.loads(ln) for ln in
+            rows_file.read_text().splitlines()]
+    assert len(rows) == 2
+    assert rows[1]["n_peers_requested"] == 1000
+    assert all(r["converged"] for r in rows)
+
+
+def test_wrapper_refuses_fleet(base_cfg):
+    from p2p_gossipprotocol_tpu.wrapper import Peer
+
+    with pytest.raises(ValueError, match="fleet"):
+        Peer(base_cfg.config_file_path, config=base_cfg)
