@@ -38,7 +38,10 @@ same rule as from_config; 0/1 forces), GOSSIP_BENCH_ROWBLK (auto:
 VMEM-budget block sizing — 2048-row blocks at W=1; an int pins it),
 GOSSIP_BENCH_FUSE_UPDATE (0),
 GOSSIP_BENCH_PULL_WINDOW (1 when roll-grouped pushpull; falls back to
-off when the overlay can't support it), GOSSIP_BENCH_CHECK_EVERY (1,
+off when the overlay can't support it), GOSSIP_BENCH_FRONTIER (0;
+-1/1 = auto/force frontier-sparse rounds — the round-8 block-skip +
+delta-exchange path, bitwise-identical to dense; the A/B lives in
+benchmarks/measure_round8.py), GOSSIP_BENCH_CHECK_EVERY (1,
 clamped to [1, MAX_ROUNDS]), GOSSIP_BENCH_STEADY_ROUNDS (256 on TPU,
 0 elsewhere), GOSSIP_BENCH_STEADY_TIMEOUT_S (420),
 GOSSIP_BENCH_FAULTS (a faults.FaultPlan spec, e.g. "drop=0.2"; also
@@ -259,6 +262,12 @@ def _bench_aligned(n, n_msgs, degree, mode):
     # In-kernel seen-update — opt-in (measured negative pre-census; the
     # in-kernel census changes its economics — measure_round6 re-A/Bs).
     fuse_update = bool(int(os.environ.get("GOSSIP_BENCH_FUSE_UPDATE", "0")))
+    # Frontier-sparse rounds (round 8): -1 auto / 0 off / 1 on.  The
+    # bench default stays 0 so headline rows remain comparable across
+    # rounds; the A/B (and the honest CPU negative, if any) lives in
+    # benchmarks/measure_round8.py, and the engine's own AUTO rule
+    # (on for the compiled path) governs production runs.
+    frontier_mode = _env_int("GOSSIP_BENCH_FRONTIER", 0)
     # VMEM row block: AUTO sizes it to the budget (wide blocks at small
     # W — the block-sizing lever against the partial-reuse gap);
     # GOSSIP_BENCH_ROWBLK pins it for A/Bs.
@@ -310,6 +319,7 @@ def _bench_aligned(n, n_msgs, degree, mode):
             max_strikes=3, liveness_every=liveness_every,
             message_stagger=stagger,
             fuse_update=fuse_update, pull_window=pw, faults=plan,
+            frontier_mode=frontier_mode,
             seed=0)
 
     try:
@@ -411,6 +421,7 @@ def _bench_aligned(n, n_msgs, degree, mode):
         **({"message_stagger": stagger} if stagger else {}),
         **({"block_perm": True} if block_perm else {}),
         **({"fuse_update": True} if fuse_update else {}),
+        **({"frontier": sim._frontier_skip} if frontier_mode else {}),
         **({"pull_window": True} if pull_window else {}),
         **({"check_every": check_every} if check_every > 1 else {}),
         # analytic traffic model (aligned.hbm_bytes_per_round) vs the
@@ -460,22 +471,32 @@ def _metric_name(n: int, mode: str, platform: str) -> str:
 
 
 def _recorded_tpu() -> dict | None:
-    """The watchdog-recorded TPU headline from THIS round, if one landed
-    (benchmarks/results/bench_r5_tpu.json): a CPU-fallback or error line
-    carries it as ``tpu_result_this_round`` so a dead tunnel at
-    round-end cannot hide a real hardware number that was already
-    measured and committed earlier in the round.
+    """The LAST RECORDED TPU headline (benchmarks/results/
+    bench_r5_tpu.json): a CPU-fallback or error line carries it as
+    ``last_recorded_tpu_result`` so a dead tunnel at round-end cannot
+    hide a real hardware number that was measured and committed
+    earlier — while the key name and the attached provenance
+    (``recorded_at`` + ``source``: the live file's mtime, or the HEAD
+    commit that last touched the committed copy) make it impossible to
+    mistake a previous round's number for this round's (ADVICE r5: the
+    old ``tpu_result_this_round`` label did exactly that after a round
+    where no TPU window landed).
 
     The watchdog runs ``bench.py > bench_r5_tpu.json`` — the shell
     truncates the file BEFORE this process starts — so an empty or
-    unparseable file falls back to the git-committed copy (HEAD), which
-    is exactly the record the docstring's contract is about."""
+    unparseable file falls back to the git-committed copy (HEAD)."""
     rel = os.path.join("benchmarks", "results", "bench_r5_tpu.json")
     repo = os.path.dirname(os.path.abspath(__file__))
     rec = None
+    prov = {}
+    path = os.path.join(repo, rel)
     try:
-        with open(os.path.join(repo, rel)) as f:
+        with open(path) as f:
             rec = json.load(f)
+        prov = {"source": "working-tree",
+                "recorded_at": time.strftime(
+                    "%Y-%m-%dT%H:%M:%S",
+                    time.localtime(os.path.getmtime(path)))}
     except (OSError, ValueError):
         try:
             blob = subprocess.run(
@@ -483,14 +504,24 @@ def _recorded_tpu() -> dict | None:
                 capture_output=True, timeout=10)
             if blob.returncode == 0:
                 rec = json.loads(blob.stdout)
+                log = subprocess.run(
+                    ["git", "-C", repo, "log", "-1",
+                     "--format=%h %cI", "--", rel],
+                    capture_output=True, timeout=10)
+                commit = log.stdout.decode().strip().split()
+                prov = {"source": "HEAD",
+                        "commit": commit[0] if commit else None,
+                        "recorded_at": (commit[1] if len(commit) > 1
+                                        else None)}
         except (OSError, ValueError, subprocess.SubprocessError):
             rec = None
     if (not isinstance(rec, dict)
             or rec.get("platform") not in ("tpu", "axon")
             or not rec.get("value")):
         return None
-    return {k: rec.get(k) for k in ("metric", "value", "unit",
-                                    "vs_baseline", "device")}
+    return {**{k: rec.get(k) for k in ("metric", "value", "unit",
+                                       "vs_baseline", "device")},
+            **prov}
 
 
 def _emit_error(n, mode, engine, err, platform: str = "unknown") -> int:
@@ -504,7 +535,7 @@ def _emit_error(n, mode, engine, err, platform: str = "unknown") -> int:
     }
     tpu = _recorded_tpu()
     if tpu:
-        row["tpu_result_this_round"] = tpu
+        row["last_recorded_tpu_result"] = tpu
     print(json.dumps(row))
     return 1
 
@@ -597,7 +628,7 @@ def main() -> int:
     if os.environ.get("GOSSIP_BENCH_IS_FALLBACK"):
         tpu = _recorded_tpu()
         if tpu:
-            fb_extras["tpu_result_this_round"] = tpu
+            fb_extras["last_recorded_tpu_result"] = tpu
     print(json.dumps({
         "metric": _metric_name(n, mode, platform),
         "value": round(wall, 4),

@@ -1,0 +1,213 @@
+"""Round-8 A/Bs: the frontier-sparse path, and the round-6 census IOU.
+
+Three measurements, one JSON row each (plus a parity column on EVERY
+row — a speedup with a different trajectory is not a result):
+
+* ``census_ab``: the in-kernel round census (fuse_update=1, the round-6
+  work whose docs/PERFORMANCE.md line read "census path awaits on-chip
+  A/B") vs the XLA 2W-plane metrics re-read, solo engine, fixed-round
+  scans.  parity = the coverage AND deliveries series are bitwise
+  equal.
+* ``frontier_solo_ab``: in-kernel dead-block skipping on vs off on the
+  solo engine at >= 256k peers — the CPU bench path's ms/round number
+  the ISSUE 5 acceptance names (an inversion here is recorded
+  honestly, like round 6's fused-path negative).
+* ``frontier_sharded_ab``: the delta-compressed exchange on a sharded
+  engine (8 shards — virtual CPU devices off-chip) vs the legacy dense
+  gathers.  The row reconstructs GATHERED BYTES per round from the
+  run's own fr_words/fr_sparse diagnostics (the exchange prices are
+  closed-form: dense legacy moves send+seen planes; the frontier path
+  moves one frontier gather — compacted (index, word) tables on sparse
+  rounds — plus two mask planes) and reports the post-peak reduction
+  ratio, acceptance >= 2x.
+
+Run on the chip (watchdog chain step measure_round8):
+    PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/measure_round8.py
+Appends to GOSSIP_R8_OUT (default benchmarks/results/round8_tpu.jsonl
+on TPU, round8_cpu.jsonl elsewhere), resuming per-config like the
+round-4..7 drivers.  Scale knobs: GOSSIP_R8_PEERS (262144),
+GOSSIP_R8_ROUNDS (10), GOSSIP_R8_SHARDS (8).
+"""
+import json
+import os
+import sys
+import time
+
+# the sharded A/B needs a multi-device mesh; off-chip that means
+# virtual CPU devices, which must be requested BEFORE jax imports
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count="
+                               + os.environ.get("GOSSIP_R8_SHARDS", "8"))
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+OUT = None
+
+
+def _out_path(cpu: bool) -> str:
+    default = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "results",
+        "round8_cpu.jsonl" if cpu else "round8_tpu.jsonl")
+    return os.environ.get("GOSSIP_R8_OUT", default)
+
+
+def emit(row):
+    row["device"] = str(jax.devices()[0]).replace(" ", "_")
+    row["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    print(json.dumps(row), flush=True)
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def _landed() -> set:
+    from benchmarks._common import landed
+    return landed(OUT)
+
+
+def _mk(n, n_msgs, frontier, fuse=False, seed=0):
+    from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator,
+                                                build_aligned)
+    from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+
+    topo = build_aligned(seed=seed, n=n, n_slots=16,
+                         degree_law="powerlaw", roll_groups=4,
+                         n_msgs=n_msgs)
+    return AlignedSimulator(
+        topo=topo, n_msgs=n_msgs, mode="pushpull",
+        churn=ChurnConfig(rate=0.05, kill_round=1), max_strikes=3,
+        liveness_every=3, fuse_update=fuse, frontier_mode=frontier,
+        seed=seed)
+
+
+def _series_equal(a, b, keys=("coverage", "deliveries")) -> bool:
+    for k in keys:
+        if not np.array_equal(np.asarray(getattr(a, k)),
+                              np.asarray(getattr(b, k))):
+            return False
+    return bool(np.array_equal(
+        np.asarray(jax.device_get(a.state.seen_w)),
+        np.asarray(jax.device_get(b.state.seen_w))))
+
+
+def bench_census(n, rounds, done):
+    """The round-6 IOU: in-kernel census (+fused update) vs the XLA
+    metrics re-read, identical trajectory asserted bitwise."""
+    if "census_ab" in done:
+        return
+    xla = _mk(n, 64, frontier=0, fuse=False)
+    kern = _mk(n, 64, frontier=0, fuse=True)
+    r_x = xla.run(rounds, warmup=True)
+    r_k = kern.run(rounds, warmup=True)
+    emit({"config": "census_ab", "n_peers": n, "rounds": rounds,
+          "n_msgs": 64,
+          "xla_ms_per_round": round(r_x.wall_s / rounds * 1e3, 2),
+          "kernel_ms_per_round": round(r_k.wall_s / rounds * 1e3, 2),
+          "speedup": round(r_x.wall_s / r_k.wall_s, 3),
+          "parity_ok": _series_equal(r_x, r_k)})
+
+
+def bench_frontier_solo(n, rounds, done):
+    if "frontier_solo_ab" in done:
+        return
+    dense = _mk(n, 16, frontier=0)
+    sparse = _mk(n, 16, frontier=1)
+    r_d = dense.run(rounds, warmup=True)
+    r_s = sparse.run(rounds, warmup=True)
+    emit({"config": "frontier_solo_ab", "n_peers": n, "rounds": rounds,
+          "n_msgs": 16,
+          "dense_ms_per_round": round(r_d.wall_s / rounds * 1e3, 2),
+          "sparse_ms_per_round": round(r_s.wall_s / rounds * 1e3, 2),
+          "speedup": round(r_d.wall_s / r_s.wall_s, 3),
+          "parity_ok": _series_equal(r_d, r_s)})
+
+
+def bench_frontier_sharded(n, rounds, shards, done):
+    """The sharded A/B runs LONGER than the solo ones: the claim under
+    measurement is the post-peak phase, and a window that ends a round
+    or two after the peak mostly measures the hysteresis transient
+    (dense rounds before the switch engages) instead of the steady
+    sparse tail a real deployment sits in."""
+    from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator,
+                                                build_aligned,
+                                                frontier_capacity)
+    from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+    from p2p_gossipprotocol_tpu.parallel import (AlignedShardedSimulator,
+                                                 make_mesh)
+
+    if "frontier_sharded_ab" in done:
+        return
+    shards = min(shards, len(jax.devices()))
+    # W=2: the realistic width regime — at W=1 the per-round alive
+    # plane gather is as large as one legacy plane gather, and the
+    # exchange can at best break even (documented in PERFORMANCE.md)
+    n_msgs = int(os.environ.get("GOSSIP_R8_SHARDED_MSGS", "64"))
+    topo = build_aligned(seed=0, n=n, n_slots=16, degree_law="powerlaw",
+                         roll_groups=4, n_msgs=n_msgs, n_shards=shards)
+    kw = dict(topo=topo, n_msgs=n_msgs, mode="pushpull",
+              churn=ChurnConfig(rate=0.05, kill_round=1),
+              max_strikes=3, liveness_every=3, seed=0)
+    dense = AlignedShardedSimulator(mesh=make_mesh(shards), **kw)
+    sparse = AlignedShardedSimulator(mesh=make_mesh(shards),
+                                     frontier_mode=1, **kw)
+    r_d = dense.run(rounds, warmup=True)
+    r_s = sparse.run(rounds, warmup=True)
+    # gathered bytes per round, reconstructed from the run's own
+    # regime/changed-word diagnostics with the closed-form exchange
+    # prices (tests/test_traffic_model.py pins the same accounting)
+    inner = sparse._inner
+    W, R, C = inner.n_words, topo.rows, 128
+    wp, plane = W * R * C * 4, R * C * 4
+    L = W * (R // shards) * C
+    K = frontier_capacity(inner.frontier_threshold, L)
+    legacy = 2 * wp                       # pushpull: send + seen gathers
+    per_round = np.where(np.asarray(r_s.fr_sparse) != 0,
+                         shards * (2 * K + 1) * 4 + plane,
+                         wp + plane)
+    # post-peak phase: rounds after the frontier-width peak
+    words = np.asarray(r_s.fr_words)
+    peak = int(words.argmax())
+    post = per_round[peak + 1:] if peak + 1 < len(per_round) \
+        else per_round[-1:]
+    reduction = legacy / float(post.mean())
+    emit({"config": "frontier_sharded_ab", "n_peers": n,
+          "rounds": rounds, "n_msgs": n_msgs, "shards": shards,
+          "dense_ms_per_round": round(r_d.wall_s / rounds * 1e3, 2),
+          "sparse_ms_per_round": round(r_s.wall_s / rounds * 1e3, 2),
+          "speedup": round(r_d.wall_s / r_s.wall_s, 3),
+          "legacy_gather_bytes_round": int(legacy),
+          "postpeak_gather_bytes_round": int(post.mean()),
+          "postpeak_reduction_x": round(reduction, 1),
+          "sparse_rounds": int(np.asarray(r_s.fr_sparse).sum()),
+          "capacity_words": int(K),
+          "parity_ok": _series_equal(r_d, r_s)})
+
+
+def main():
+    global OUT
+    backend = jax.default_backend()
+    on_tpu = backend in ("tpu", "axon")
+    OUT = _out_path(cpu=not on_tpu)
+    n = int(os.environ.get("GOSSIP_R8_PEERS", str(1 << 18)))
+    rounds = int(os.environ.get("GOSSIP_R8_ROUNDS", "10"))
+    shards = int(os.environ.get("GOSSIP_R8_SHARDS", "8"))
+    done = _landed()
+    if "_backend" not in done:
+        emit({"config": "_backend", "backend": backend, "n_peers": n,
+              "rounds": rounds, "parity_ok": True})
+    bench_census(n, rounds, done)
+    bench_frontier_solo(n, rounds, done)
+    bench_frontier_sharded(
+        n, int(os.environ.get("GOSSIP_R8_SHARDED_ROUNDS", "20")),
+        shards, done)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

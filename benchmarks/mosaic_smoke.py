@@ -56,13 +56,24 @@ def _check(name, fn):
         return False
 
 
+def _popcount(arr) -> int:
+    return int(np.unpackbits(
+        np.ascontiguousarray(np.asarray(arr)).view(np.uint8)).sum())
+
+
 def _run_pair(mk_sim, rounds=6):
     """Run the same config compiled (Mosaic) and interpreted; assert the
     end state AND the per-round census are bitwise identical (on
     fuse_update configs the coverage/deliveries series come from the
     round-6 in-kernel census — its partial-popcount tiles must
-    reproduce the interpreted values exactly).  Returns the compiled
-    result."""
+    reproduce the interpreted values exactly).  Also recounts the final
+    round's FRONTIER POPCOUNT on the host: in this engine deliveries ==
+    frontier bits by construction, so the census's last deliveries
+    value must equal popcount(state.frontier_w) exactly — the round-8
+    frontier path derives its regime signal and block-activity masks
+    from these same bits, so a census that drifted here would skew the
+    sparse/dense switch (never correctness, which is gate-exact, but
+    the traffic claims).  Returns the compiled result."""
     mosaic = mk_sim(False).run(rounds)
     interp = mk_sim(True).run(rounds)
     np.testing.assert_array_equal(np.asarray(mosaic.state.seen_w),
@@ -75,7 +86,28 @@ def _run_pair(mk_sim, rounds=6):
                                   np.asarray(interp.coverage))
     np.testing.assert_array_equal(np.asarray(mosaic.deliveries),
                                   np.asarray(interp.deliveries))
+    # frontier-popcount census parity (round 8): valid whenever no
+    # relay-delay fault defers frontier bits (none of the smoke
+    # variants configures one)
+    assert int(np.asarray(mosaic.deliveries)[-1]) == _popcount(
+        mosaic.state.frontier_w), "census vs host frontier popcount"
     return mosaic
+
+
+def _ab_pair(mk_sim, rounds=6):
+    """COMPILED dense vs COMPILED frontier-sparse of the same config —
+    the on-chip half of the round-8 bitwise contract (the CPU suite
+    covers it in interpret mode only; this is where Mosaic actually
+    compiles the skip-table index maps and the activity gate)."""
+    dense = mk_sim(0).run(rounds)
+    sparse = mk_sim(1).run(rounds)
+    np.testing.assert_array_equal(np.asarray(dense.state.seen_w),
+                                  np.asarray(sparse.state.seen_w))
+    np.testing.assert_array_equal(np.asarray(dense.coverage),
+                                  np.asarray(sparse.coverage))
+    np.testing.assert_array_equal(np.asarray(dense.deliveries),
+                                  np.asarray(sparse.deliveries))
+    return sparse
 
 
 def main():
@@ -195,6 +227,28 @@ def main():
             fuse_update=True,
             churn=ChurnConfig(rate=0.05, kill_round=1), max_strikes=2,
             liveness_every=3, seed=1, interpret=interp)) and None))
+
+    # 6f) frontier block skipping (round 8): the skip-table y index
+    #     maps + in-kernel activity gate, never Mosaic-compiled by the
+    #     CPU suite.  Compiled-vs-interp on both overlay families, and
+    #     compiled dense-vs-sparse (the bitwise A/B the round-8
+    #     contract hinges on), composed with fuse_update so the skip
+    #     tables ride next to the census prefetch.
+    results.append(_check("frontier_skip", lambda: _run_pair(
+        lambda interp: AlignedSimulator(
+            topo=topo_rg, n_msgs=64, mode="pushpull", frontier_mode=1,
+            churn=ChurnConfig(rate=0.05, kill_round=1), max_strikes=2,
+            liveness_every=3, seed=1, interpret=interp)) and None))
+    results.append(_check("frontier_skip_block_perm", lambda: _run_pair(
+        lambda interp: AlignedSimulator(
+            topo=topo_bp, n_msgs=64, mode="pushpull", frontier_mode=1,
+            fuse_update=True, seed=1, interpret=interp)) and None))
+    results.append(_check("frontier_ab_compiled", lambda: _ab_pair(
+        lambda fm: AlignedSimulator(
+            topo=topo_rg, n_msgs=64, mode="pushpull", frontier_mode=fm,
+            churn=ChurnConfig(rate=0.05, kill_round=1), max_strikes=2,
+            liveness_every=3, fuse_update=True, seed=1,
+            interpret=False)) and None))
 
     # 7) SIR count_pass
     def sir_pair():

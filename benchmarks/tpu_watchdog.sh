@@ -109,7 +109,7 @@ record_fail() {
   fi
 }
 
-STEP_NAMES="bench mosaic_smoke measure_round4 measure_round5 measure_round6 measure_round7 baselines longrun"
+STEP_NAMES="bench mosaic_smoke measure_round4 measure_round5 measure_round6 measure_round7 measure_round8 baselines longrun"
 # Headline first: a short tunnel window must yield the most important
 # artifact.  bench keeps its file contract (ONE parsed line) and only
 # stamps when the line really came from the chip.  longrun is the
@@ -132,6 +132,7 @@ PY" ;;
     measure_round5) echo "python benchmarks/measure_round5.py" ;;
     measure_round6) echo "python benchmarks/measure_round6.py" ;;
     measure_round7) echo "python benchmarks/measure_round7.py" ;;
+    measure_round8) echo "python benchmarks/measure_round8.py" ;;
     baselines)      echo "python benchmarks/run_baselines.py" ;;
     longrun)
       # resume whenever a committed checkpoint exists — covers both the
@@ -151,6 +152,7 @@ step_tmo() {
     measure_round4) echo 4800 ;; measure_round5) echo 3600 ;;
     measure_round6) echo 3600 ;;
     measure_round7) echo 3600 ;;
+    measure_round8) echo 3600 ;;
     baselines) echo 4800 ;;
     longrun) echo 1800 ;;
   esac

@@ -42,7 +42,8 @@ from p2p_gossipprotocol_tpu import faults as faults_lib
 from p2p_gossipprotocol_tpu.liveness import ChurnConfig
 from p2p_gossipprotocol_tpu.ops.aligned_kernel import (LANES, gossip_pass,
                                                        liveness_pass,
-                                                       neighbor_ids)
+                                                       neighbor_ids,
+                                                       skip_tables)
 
 WORD_BITS = 32
 # VMEM ceiling for the gossip kernel: the y and acc blocks are
@@ -71,6 +72,18 @@ Y_REUSE_LEAK = 0.43
 # ms/round at W=8 (256 msgs) and a wash at W=1 (16 msgs) — the deleted
 # prep term scales with W, so the crossover sits between.
 AUTO_BLOCK_PERM_MIN_WORDS = 4
+
+# Frontier-sparse delta-exchange capacity, as a fraction of each
+# shard's packed words.  Epidemic dissemination is frontier-bound: past
+# the infection peak the per-round delta collapses to a sliver of the
+# planes, yet the dense exchange still moves all of them.  The sparse
+# regime ships (global word index, delta word) PAIRS — 2 int32 per
+# changed word, vs 1 per word dense — so it only pays below ~L/2
+# changed words; 1/64 keeps the sparse gather under ~3% of the dense
+# transfer, far enough below breakeven that the compaction/scatter
+# overhead can't erase the win, while post-peak rounds (typically
+# <0.1% of words changed) fit with orders of magnitude to spare.
+FRONTIER_THRESHOLD_DEFAULT = 1.0 / 64.0
 
 # from_config's VMEM-budget row-block cap: at small W the budget admits
 # blocks far wider than the legacy 512 (W=1 -> 2048 rows/block), which
@@ -388,6 +401,143 @@ class AlignedState:
     round: jax.Array
 
 
+@struct.dataclass
+class FrontierCarry:
+    """Scan carry of the frontier-sparse exchange (sharded engines).
+
+    ``replica_w`` is each chip's persistent copy of the UNPERMUTED
+    global seen planes (int32[W_local, R_global, 128]); ``regime`` the
+    on-device two-regime flag (0 dense / 1 sparse) with hysteresis.
+    Both are DERIVED state, deliberately excluded from checkpoints: the
+    replica equals the global seen planes at every round boundary (the
+    engines initialize it from ``state.seen_w`` — correct for fresh
+    AND resumed states alike), and the regime flag never influences the
+    trajectory (both regimes are bitwise-identical), so a resume that
+    restarts dense re-converges to the same regime on its own — the
+    "checkpoints resume bitwise across the regime switch" contract
+    costs nothing by construction.  ``replica_w`` is None in pure push
+    mode (no pass reads global seen).
+
+    ``byz_g`` (row-perm overlays only): the GATHERED byzantine words —
+    the byzantine draw is static for a run, so the frontier path hoists
+    its per-round plane gather to ONE gather at carry init; the fused
+    path masks through ``src_ok`` and carries None."""
+
+    replica_w: jax.Array | None
+    byz_g: jax.Array | None
+    regime: jax.Array              # int32 scalar
+
+
+def frontier_capacity(threshold: float, local_words: int) -> int:
+    """Compacted delta capacity per shard, in int32 words — the static
+    shape of the sparse gather (128-aligned, floored so toy shards
+    still have a usable window, capped at the shard's own size)."""
+    k = int(threshold * local_words)
+    return max(min(128, local_words), min(local_words,
+                                          -(-k // 128) * 128))
+
+
+def _frontier_exchange(sim, frontier_l: jax.Array, fr: FrontierCarry,
+                       axis: str, pmax_axes, n_shards: int):
+    """One round's cross-chip exchange on the frontier-sparse path —
+    the drop-in replacement for the dense ``all_gather`` of the send
+    planes, exact by seen-set monotonicity.
+
+    Every bit the network state gains in a round enters through the
+    frontier (byzantine injection and staggered generation write
+    frontier and seen together; deferred-relay bits re-entering the
+    frontier are already in seen), so:
+
+      * the globalized FRONTIER is a scatter of each shard's nonzero
+        frontier words into zeros (words are row-owned — no two shards
+        ever contribute the same global word), and
+      * the global SEEN replica advances by ``replica | frontier`` —
+        OR-idempotent on the deferred re-entries, so the replica equals
+        ``all_gather(seen)`` bitwise at every round, on either regime.
+
+    Dense regime: one ``all_gather`` of the W frontier planes (already
+    half the legacy pushpull exchange, which gathered send AND seen).
+    Sparse regime: each shard compacts its changed words into a static
+    ``K = frontier_capacity(...)``-word (global index, word) table;
+    the gather moves ``2K+1`` int32 per shard instead of the planes,
+    and a scatter-ADD rebuilds the global frontier (exact: deltas are
+    bit-disjoint from zeros, and per-word single-writer).  The regime
+    flag flips on-device with hysteresis — enter sparse below K/2
+    changed words on the WORST shard, leave only past K (where the
+    compaction no longer fits and dense is forced anyway) — so the
+    choice lives inside the compiled scan with no host sync.
+
+    Returns ``(F_global, fr', went_sparse, worst_words)``."""
+    W_l, Rl, C = frontier_l.shape
+    Rg = Rl * n_shards
+    L = W_l * Rl * C
+    K = frontier_capacity(sim.frontier_threshold, L)
+    grow0 = jax.lax.axis_index(axis) * Rl
+    changed = (frontier_l != 0).reshape(-1)
+    n_changed = jnp.sum(changed, dtype=jnp.int32)
+    worst = n_changed
+    for ax in pmax_axes:
+        worst = jax.lax.pmax(worst, ax)
+
+    def dense(_):
+        return jax.lax.all_gather(frontier_l, axis, axis=1, tiled=True)
+
+    def sparse(_):
+        flat = frontier_l.reshape(-1)
+        pos = jnp.cumsum(changed, dtype=jnp.int32) - 1
+        i = jnp.arange(L, dtype=jnp.int32)
+        # global word id of local word i: plane-major over global rows
+        g_i = (i // (Rl * C)) * (Rg * C) + grow0 * C + i % (Rl * C)
+        # compaction: changed word j lands at slot pos[j] (< K on this
+        # branch — the cond predicate guarantees the fit); unchanged
+        # words ADD zero at slot 0, which no real word can lose to
+        tgt = jnp.where(changed, jnp.minimum(pos, K - 1), 0)
+        vals = jnp.zeros(K, jnp.int32).at[tgt].add(
+            jnp.where(changed, flat, 0))
+        idxs = jnp.zeros(K, jnp.int32).at[tgt].add(
+            jnp.where(changed, g_i, 0))
+        idx_g = jax.lax.all_gather(idxs, axis)          # [S, K]
+        val_g = jax.lax.all_gather(vals, axis)          # [S, K]
+        cnt_g = jax.lax.all_gather(n_changed, axis)     # [S]
+        valid = jnp.arange(K, dtype=jnp.int32)[None, :] < cnt_g[:, None]
+        # scatter-ADD == scatter-OR here: targets are zero and each
+        # global word has exactly one owner shard; invalid slots add 0
+        F = jnp.zeros(W_l * Rg * C, jnp.int32).at[
+            jnp.where(valid, idx_g, 0).reshape(-1)].add(
+            jnp.where(valid, val_g, 0).reshape(-1))
+        return F.reshape(W_l, Rg, C)
+
+    went_sparse = (fr.regime == 1) & (worst <= K)
+    F = jax.lax.cond(went_sparse, sparse, dense, None)
+    regime2 = jnp.where(fr.regime == 1, worst <= K,
+                        worst <= K // 2).astype(jnp.int32)
+    replica2 = None if fr.replica_w is None else fr.replica_w | F
+    return (F, FrontierCarry(replica_w=replica2, byz_g=fr.byz_g,
+                             regime=regime2),
+            went_sparse.astype(jnp.int32), worst)
+
+
+def _skip_plan(y: jax.Array, rowblk: int, t_local: int,
+               rolls_off: jax.Array | None = None,
+               ytab_local: jax.Array | None = None):
+    """(yidx, yact) for the push pass's in-kernel block skipping: mark
+    every y block whose send words are all zero (it contributes nothing
+    to the OR — gating it is exact by construction, however the mask
+    was derived) and remap dead grid steps onto the resident buffer
+    (ops/aligned_kernel.skip_tables).  Costs one read of the send
+    planes (the traffic model's ``frontier_scan`` term) against up to
+    D-1 saved block streams per dead block."""
+    W_l, Ry, C = y.shape
+    Ty = Ry // rowblk
+    act = jnp.any((y != 0).reshape(W_l, Ty, rowblk * C), axis=(0, 2))
+    if ytab_local is not None:
+        idx_raw = ytab_local.T                          # [T, D]
+    else:
+        t = jnp.arange(t_local, dtype=jnp.int32)
+        idx_raw = (t[:, None] + rolls_off[None, :]) % Ty
+    return skip_tables(idx_raw, act)
+
+
 def _popcount_sum(words: jax.Array) -> jax.Array:
     return jnp.sum(jax.lax.population_count(words), dtype=jnp.int32)
 
@@ -546,6 +696,18 @@ class AlignedSimulator:
     #: sharded-vs-unsharded parity contract.  None = no faults, and
     #: the compiled round is exactly the pre-fault-plane program.
     faults: object | None = None
+    #: frontier-sparse rounds: -1 auto (on for the compiled TPU path,
+    #: off under interpret — the extra XLA-side work inverts there,
+    #: the round-6 fused-path precedent), 0 off, 1 on.  On: the push
+    #: pass skips dead sender blocks in-kernel (``_skip_plan``), and
+    #: the sharded engines run the delta-compressed exchange
+    #: (``_frontier_exchange``).  Bitwise-identical to the dense path
+    #: by construction — state AND every metric — so it is excluded
+    #: from checkpoint fingerprints like fuse_update.
+    frontier_mode: int = 0
+    #: sparse-exchange capacity per shard as a fraction of its packed
+    #: words (FRONTIER_THRESHOLD_DEFAULT has the derivation).
+    frontier_threshold: float = FRONTIER_THRESHOLD_DEFAULT
     seed: int = 0
     interpret: bool | None = None   # None -> interpret unless on TPU
 
@@ -656,6 +818,18 @@ class AlignedSimulator:
                     "cycle — use pushpull, or a row-perm overlay")
         else:
             self._pull_slots = self.topo.n_slots
+        # Frontier-sparse resolution (after ``interpret`` is known —
+        # auto keys off it): block skipping needs a push pass to skip
+        # in; the delta exchange engages only when a sharded engine
+        # passes its FrontierCarry into the round.
+        if self.frontier_mode not in (-1, 0, 1):
+            raise ValueError("frontier_mode must be -1 (auto), 0, or 1")
+        if not 0.0 < self.frontier_threshold <= 1.0:
+            raise ValueError("frontier_threshold must be in (0, 1]")
+        fr_on = (self.frontier_mode == 1
+                 or (self.frontier_mode == -1 and not self.interpret))
+        self._frontier_skip = fr_on and self.mode in ("push", "pushpull")
+        self._frontier_delta = fr_on
         # Liveness (strikes/rewire) runs whenever peers can die — without
         # churn no neighbor is ever observed dead, so the pass is skipped
         # statically and the strike plane is never allocated.
@@ -761,6 +935,16 @@ class AlignedSimulator:
                     "overlay -> classic pull (windowed anti-entropy "
                     "would be confined to one block cycle)")
                 pull_window = False
+        # Frontier-sparse rounds: AUTO (-1, the default) resolves
+        # against the backend in __post_init__ (on for the compiled
+        # path, off under interpret — same honesty as the round-6
+        # fused-path negative).  An EXPLICIT on is honored — it is
+        # always bitwise-safe — but a combination where half the
+        # feature cannot exist is recorded, never silent.
+        if cfg.frontier_mode == 1 and cfg.mode == "pull":
+            clamps.append(
+                "frontier_mode 1 with mode=pull -> delta exchange only "
+                "(pure pull has no push pass to block-skip)")
         # n_msgs sizes the kernel's VMEM row block: wide message sets
         # shrink it (W * rowblk <= budget), and NARROW ones now widen it
         # up to MAX_CONFIG_ROWBLK — fewer grid steps and longer DMA
@@ -803,14 +987,31 @@ class AlignedSimulator:
                    pull_window=pull_window,
                    faults=(plan if plan and plan.engine_active()
                            else None),
+                   frontier_mode=cfg.frontier_mode,
+                   frontier_threshold=cfg.frontier_threshold,
                    seed=cfg.prng_seed)
 
     # ------------------------------------------------------------------
-    def traffic_model(self) -> dict:
+    def traffic_model(self, frontier_fill: float | None = None,
+                      n_shards: int = 1) -> dict:
         """Per-term analytic HBM model for one average round — the
         denominator behind the bench line's ``achieved_gb_s`` (measured
         wall-clock per round vs bytes this model says the round moves,
         comparable against the chip's ~800 GB/s HBM roof).
+
+        Frontier-aware terms (round 8): with block skipping active
+        (``_frontier_skip``) the push pass's y replay honors an
+        activity mask of ``ceil(frontier_fill * T)`` evenly-spaced live
+        blocks (``frontier_fill`` in [0, 1]; None = 1.0, the dense
+        upper bound — the model never flatters a run whose frontier
+        width it cannot know), and a ``frontier_scan`` term charges the
+        one extra read of the send planes the activity reduce costs.
+        With ``n_shards > 1`` and the delta exchange active, a
+        ``delta_gather`` term gives the per-chip interconnect bytes of
+        the exchange at that fill: the compacted ``(index, word)``
+        tables when the changed words fit the capacity, the dense W
+        frontier planes otherwise, plus the two per-peer mask planes
+        the non-fused path gathers post-exchange.
 
         Kernel terms replay the grid's actual DMA-descriptor sequence
         (ops/aligned_kernel.stream_plan): a block whose index map
@@ -843,13 +1044,29 @@ class AlignedSimulator:
         rolls = np.asarray(topo.rolls)
         ytab = None if topo.ytab is None else np.asarray(topo.ytab)
 
+        fill = 1.0 if frontier_fill is None else min(max(
+            frontier_fill, 0.0), 1.0)
+        push_active = None
+        if self._frontier_skip:
+            # evenly spaced live blocks — the replay's stand-in for a
+            # frontier this wide (any placement; the replay's dedup
+            # makes spacing second-order)
+            k_act = int(np.ceil(fill * T))
+            push_active = np.zeros(T, bool)
+            if k_act > 0:
+                push_active[np.floor(
+                    np.arange(k_act) * T / k_act).astype(int)] = True
+
         def y_eff(plan):
             # calibrated partial reuse: full streams for index changes,
             # leak-fraction streams for resident-buffer re-serves
+            # (skip-gated steps are re-serves of the pinned resident
+            # block — same charge, so the model stays conservative)
             return plan["y"] + leak * (plan["y_naive"] - plan["y"])
 
-        def pass_bytes(n_slots_d, final, seeded):
-            plan = stream_plan(rolls, T, ytab=ytab, n_slots=n_slots_d)
+        def pass_bytes(n_slots_d, final, seeded, active=None):
+            plan = stream_plan(rolls, T, ytab=ytab, n_slots=n_slots_d,
+                               active=active)
             eff = y_eff(plan)
             b = eff * W * blk * C * 4    # packed sender planes
             b += plan["tab"] * blk * C   # colidx (int8)
@@ -868,7 +1085,8 @@ class AlignedSimulator:
         terms = {}
         if self.mode in ("push", "pushpull"):
             terms["push_pass"] = pass_bytes(
-                D, final=fin and self.mode == "push", seeded=False)
+                D, final=fin and self.mode == "push", seeded=False,
+                active=push_active)
         if self.mode in ("pull", "pushpull"):
             # Pull-window: a window-sized grid whose slots share one
             # block roll — the replay sees the single stream directly.
@@ -898,6 +1116,27 @@ class AlignedSimulator:
             # new (deliveries) and seen (coverage) planes
             terms["update"] = (n_passes + 3) * wp
             terms["metrics"] = 2 * wp + 2 * plane
+        if self._frontier_skip and "push_pass" in terms:
+            # the per-block activity reduce reads the send planes once
+            terms["frontier_scan"] = wp
+        if n_shards > 1 and self._frontier_delta:
+            # interconnect bytes of the exchange, per chip per round
+            # (the measure_round8 A/B's gathered-bytes column): the
+            # sparse table when the worst shard's changed words fit K,
+            # the dense frontier planes otherwise; the non-fused path
+            # additionally gathers the alive/byz mask planes it now
+            # applies post-exchange.
+            L = W * (R // n_shards) * C
+            K = frontier_capacity(self.frontier_threshold, L)
+            changed = int(fill * L)
+            delta = (n_shards * (2 * K + 1) * 4 if changed <= K
+                     else wp)
+            if not fused:
+                # the alive mask plane, gathered post-exchange each
+                # round (the static byzantine plane gathers once at
+                # carry init and is amortized to ~0)
+                delta += plane
+            terms["delta_gather"] = delta
         terms = {k: int(v) for k, v in terms.items()}
         terms["total"] = sum(terms.values())
         return terms
@@ -1102,8 +1341,11 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
                   w_off: jax.Array | int = 0,
                   msg_only_reduce=None,
                   hash_seed: jax.Array | None = None,
-                  msg_srcs: jax.Array | None = None
-                  ) -> tuple[AlignedState, AlignedTopology, dict]:
+                  msg_srcs: jax.Array | None = None,
+                  fr: FrontierCarry | None = None,
+                  fr_axis: str | None = None,
+                  fr_pmax_axes: tuple = (),
+                  fr_shards: int = 1):
     """THE round implementation, shared by the single-chip engine,
     AlignedShardedSimulator (parallel/aligned_sharded.py) and the 2-D
     peers x message-planes engine (parallel/aligned_2d.py).
@@ -1131,6 +1373,19 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
         (defaults to ``sim._message_plan()``).  Both default to the
         solo engine's values, so every existing caller compiles the
         exact program it always did.
+      * ``fr``/``fr_axis``/``fr_pmax_axes``/``fr_shards`` — the
+        frontier-sparse exchange (sharded engines only): a
+        :class:`FrontierCarry`, the mesh axis the send planes gather
+        over, the axes the regime signal reduces over, and the peer
+        shard count.  With ``fr`` the round REPLACES the dense send
+        gathers with :func:`_frontier_exchange`'s output (the global
+        frontier scatter and the per-chip seen replica), applies the
+        row permutation and the alive/byzantine send masks locally
+        POST-gather (so gathered content stays monotone), and returns
+        a 4-tuple ``(state, topo, metrics, fr')`` — every other
+        caller keeps the 3-tuple.  The fault plane's drop gates hash
+        (receiver, slot, round) — never the transported words — so
+        both paths see identical gate decisions by construction.
     Everything else — churn, strikes/rewire, byzantine, gossip passes,
     metrics — is this one code path, so the engines cannot drift."""
     if msg_reduce is None:
@@ -1290,6 +1545,26 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
             jax.lax.dynamic_slice(frontier_w, cell, (1, 1, 1)) | bit,
             cell)
 
+    # -- frontier-sparse exchange (sharded engines, fr is not None) ----
+    # Runs AFTER the injections above: byzantine junk and staggered
+    # sources enter seen AND frontier together, which is exactly what
+    # keeps the exchange's monotonicity argument airtight (every bit
+    # the round gains rides the frontier).  The dense gathers below are
+    # then replaced wholesale; permutation and send masks apply
+    # post-gather, bitwise-identically (AND and the row gather commute
+    # elementwise with the all_gather layout).
+    F_g = seen_g = g_alive = g_byz = g_defer = None
+    fr_sparse = fr_words = None
+    if fr is not None:
+        F_g, fr, fr_sparse, fr_words = _frontier_exchange(
+            sim, frontier_w, fr, fr_axis, fr_pmax_axes, fr_shards)
+        seen_g = fr.replica_w
+        if not fused:
+            g_alive = gather(alive_w)
+            g_byz = fr.byz_g        # static draw, gathered at carry init
+            if defer_w is not None:
+                g_defer = gather(defer_w)
+
     if fused:
         # the in-kernel send mask: -1 where the source is alive and
         # honest (dead peers don't send; byzantine peers never relay);
@@ -1324,13 +1599,30 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
     if sim.mode in ("push", "pushpull"):
         # Dead peers don't send; byzantine peers never relay (suppression,
         # models/gossip.py:50-58) — both masked at the source words.
-        if fused:
+        if fr is not None:
+            if fused:
+                y = F_g
+            else:
+                send_g = F_g & g_alive[None] & ~g_byz[None]
+                if g_defer is not None:
+                    send_g = send_g & ~g_defer[None]
+                y = prow(send_g)
+        elif fused:
             y = gather(frontier_w)
         else:
             send = frontier_w & alive_w[None] & ~state.byz_w[None]
             if defer_w is not None:
                 send = send & ~defer_w[None]
             y = prow(gather(send))
+        yidx = yact = None
+        if sim._frontier_skip:
+            # in-kernel block skipping: y blocks with no send bits this
+            # round are gated off and never streamed — exact however
+            # sparse or dense the frontier is (dead blocks OR in zero)
+            yidx, yact = _skip_plan(
+                y, topo.rowblk, state.seen_w.shape[1] // topo.rowblk,
+                rolls_off=rolls_off,
+                ytab_local=ytab_local if fused else None)
         if sim.fanout > 0:
             # Rumor mongering: each peer listens on a random fanout-slot
             # window this round (shard-invariant per-row draw, same
@@ -1352,6 +1644,7 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
                            census_hmask=hmask if push_final else None,
                            fault_meta=fmeta_push if kf else None,
                            gbase=gbase_f if kf else None,
+                           yidx=yidx, yact=yact,
                            rowblk=topo.rowblk,
                            interpret=sim.interpret)
         if push_final:
@@ -1366,7 +1659,12 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
         # group only and the pass runs a Dw-slot grid (one shared block
         # roll -> ONE seen-plane stream); Dw == n_slots when off, which
         # reproduces the unrestricted draw and grid exactly.
-        if fused:
+        if fr is not None:
+            # the per-chip replica IS gather(seen) bitwise — the dense
+            # seen gather does not exist on this path at all
+            ys = (seen_g if fused
+                  else prow(seen_g & g_alive[None] & ~g_byz[None]))
+        elif fused:
             ys = gather(state.seen_w)
         else:
             ys = prow(gather(
@@ -1459,7 +1757,18 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
     state = AlignedState(seen_w=seen, frontier_w=frontier, alive_b=alive_b,
                          byz_w=state.byz_w, strikes=strikes, key=key,
                          round=state.round + 1)
-    return state, topo, {"coverage": coverage, "deliveries": deliveries,
-                         "frontier_size": deliveries,
-                         "live_peers": live, "evictions": n_evict,
-                         "redeliveries": redeliveries}
+    metrics = {"coverage": coverage, "deliveries": deliveries,
+               "frontier_size": deliveries,
+               "live_peers": live, "evictions": n_evict,
+               "redeliveries": redeliveries}
+    if fr is None:
+        return state, topo, metrics
+    # Exchange DIAGNOSTICS, not simulation metrics: fr_words (the worst
+    # shard's changed-word count — identical on either regime) and
+    # fr_sparse (which regime this round actually ran).  They ride the
+    # history so the A/B can reconstruct gathered bytes per round; the
+    # six canonical metrics above stay bitwise-identical to every other
+    # engine's.
+    metrics["fr_sparse"] = fr_sparse
+    metrics["fr_words"] = fr_words
+    return state, topo, metrics, fr

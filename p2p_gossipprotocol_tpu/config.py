@@ -117,6 +117,13 @@ _SIM_INT_KEYS = {
     # group only; the pull pass then streams ONE seen-plane copy
     # (aligned.AlignedSimulator.pull_window; needs roll_groups).
     "pull_window": "pull_window",
+    # aligned engine: frontier-sparse rounds — 1 = on (in-kernel dead
+    # sender-block skipping + delta-compressed cross-chip exchange on
+    # the sharded engines), 0 = off, -1 (default) = auto-select on the
+    # compiled (non-interpret) path only.  Bitwise-identical to the
+    # dense path by construction (docs/ARCHITECTURE.md "The frontier
+    # seam").
+    "frontier_mode": "frontier_mode",
     "rounds": "rounds",
     "prng_seed": "prng_seed",
     # jax backend: rounds between successive message activations —
@@ -176,6 +183,11 @@ _SIM_FLOAT_KEYS = {
     # Fleet engine: coverage target for convergence masking + bucket
     # early-exit (0 = run every scenario the full fixed round count).
     "sweep_target": "sweep_target",
+    # aligned engine: frontier-sparse delta-exchange capacity as a
+    # fraction of each shard's packed words — the sparse regime engages
+    # when every shard's changed-word count fits (with hysteresis;
+    # aligned.FRONTIER_THRESHOLD_DEFAULT has the derivation).
+    "frontier_threshold": "frontier_threshold",
 }
 _SIM_STR_KEYS = {
     "local_ip": "local_ip",
@@ -252,6 +264,18 @@ class NetworkConfig:
         self.block_perm = -1
         self.fuse_update = 0           # aligned engine; 1 = in-kernel seen|new
         self.pull_window = 1           # aligned engine; 0 = classic pull
+        # aligned engine: frontier-sparse rounds — -1 = AUTO (on for the
+        # compiled TPU path, off under interpret, where the extra XLA
+        # work inverts — the round-6 fused-path precedent), 0/1 force.
+        # Exact by seen-set monotonicity, so forcing it on is always
+        # SAFE, never a different trajectory.
+        self.frontier_mode = -1
+        # delta-exchange capacity per shard as a fraction of its packed
+        # words (aligned.FRONTIER_THRESHOLD_DEFAULT = 1/64: the sparse
+        # gather must be well under the dense plane transfer to pay for
+        # its bitmap+scatter overhead; 2*K words of idx+val vs L words
+        # dense -> a 1/64 cap bounds the sparse gather at ~3% of dense).
+        self.frontier_threshold = 1.0 / 64.0
         self.rounds = 0
         self.message_stagger = 0       # 0 = all rumors at round 0
         self.mesh_devices = 0          # 0/1 = single device
@@ -412,6 +436,10 @@ class NetworkConfig:
         if self.block_perm < -1:
             # -1 = auto-select (the default); 0/1 force off/on
             raise ConfigError("block_perm must be -1 (auto), 0, or 1")
+        if self.frontier_mode not in (-1, 0, 1):
+            raise ConfigError("frontier_mode must be -1 (auto), 0, or 1")
+        if not (0.0 < self.frontier_threshold <= 1.0):
+            raise ConfigError("frontier_threshold must be in (0, 1]")
         # msg_shards/mesh_devices CROSS-field rules are deliberately not
         # checked here: CLI flags may override engine/mode/mesh after
         # load, so the combination is validated at engine-selection time

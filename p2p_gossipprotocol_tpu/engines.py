@@ -158,10 +158,14 @@ def config_keys(cfg, n_peers: int | None = None) -> dict:
     ``msg_shards``) — migrating a checkpoint across layouts is the
     elastic-resume contract, and the bitwise sharded-vs-unsharded parity
     tests (docs/PARITY.md) guarantee the trajectory doesn't depend on
-    them — and ``fuse_update``, whose in-kernel update/census path is
-    bitwise-parity-tested against the XLA path (test_fuse_update.py).
-    Everything that picks the overlay, the model, the randomness chain,
-    or the fault schedule is included."""
+    them — ``fuse_update``, whose in-kernel update/census path is
+    bitwise-parity-tested against the XLA path (test_fuse_update.py) —
+    and the ``frontier_*`` keys, whose sparse execution path is
+    bitwise-identical to the dense one by seen-set monotonicity
+    (tests/test_frontier.py), so a checkpoint migrates freely between
+    frontier-sparse and dense readers.  Everything that picks the
+    overlay, the model, the randomness chain, or the fault schedule is
+    included."""
     return {
         "n_peers": n_peers or cfg.n_peers or len(cfg.seed_nodes),
         "n_messages": cfg.n_messages or cfg.max_message_count,
@@ -318,7 +322,10 @@ def build_simulator(cfg, *, n_peers: int | None = None,
             liveness_every=sim.liveness_every,
             message_stagger=sim.message_stagger,
             fuse_update=sim.fuse_update, pull_window=sim.pull_window,
-            faults=sim.faults, seed=sim.seed)
+            faults=sim.faults,
+            frontier_mode=sim.frontier_mode,
+            frontier_threshold=sim.frontier_threshold,
+            seed=sim.seed)
         if msg_shards > 1:
             # 2-D mesh: message planes x peer rows (the SP analogue,
             # parallel/aligned_2d.py)

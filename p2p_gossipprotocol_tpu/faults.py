@@ -31,6 +31,17 @@ replays the remaining fault schedule bit-identically from the restored
 round counter (asserted in tests/test_checkpoint.py's crash-schedule
 resume test).
 
+Frontier-sparse interaction (round 8): the drop/partition gates hash
+``(receiver, slot, round)`` and the defer/crash draws fold per global
+row — none of them ever reads the TRANSPORTED words — so the sparse
+execution path (delta-compressed exchange, skip-gated kernels,
+``aligned._frontier_exchange``) sees identical gate decisions on
+identical words by construction.  The one subtlety is ``delay``: a
+deferred relay re-enters the frontier with bits ALREADY in seen, which
+the sparse path's replica update absorbs because OR is idempotent
+(``replica | frontier == replica | new``); the faulted sparse-vs-dense
+equality is asserted in tests/test_frontier.py across the full plan.
+
 Fault model granularity (documented, asserted in tests/test_faults.py):
 
 * ``link_drop`` — each DIRECTED link transfer independently fails this

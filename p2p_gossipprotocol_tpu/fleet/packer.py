@@ -42,6 +42,10 @@ def bucket_signature(sim) -> tuple:
         sim.n_msgs, sim._n_honest, sim.mode, sim.fanout,
         sim.max_strikes, sim.liveness_every, sim.message_stagger,
         sim.fuse_update, sim.pull_window, sim._pull_slots,
+        # the RESOLVED frontier block-skip flag, not the raw mode: it
+        # alone decides whether the skip tables enter the trace (the
+        # delta exchange never runs on the fleet's single device)
+        sim._frontier_skip,
         sim._liveness,
         (sim.churn.rate, sim.churn.revive, sim.churn.kill_round),
         sim.faults,            # frozen dataclass or None — hashable

@@ -74,14 +74,24 @@ def _fold8(x):
 
 def _pass_kernel(pull: bool, n_planes: int, fanout: int, masked: bool,
                  has_init: bool, finalize: bool, census: bool,
-                 faulty: bool, n_pref: int, *refs):
+                 faulty: bool, skipped: bool, n_pref: int, *refs):
     pref, rest = refs[:n_pref], refs[n_pref:]
     subrolls_ref = pref[1]        # pref[0]=rolls, pref[2]=ytab (fused)
+    base = 3 if masked else 2     # slots taken by rolls/subrolls[/ytab]
+    if skipped:
+        # Frontier block-skip tables (int32[D, T] scalar prefetch):
+        # pref[base] is the REMAPPED y index table (dead sender blocks
+        # pinned to the previous grid step's index, so the pipeline
+        # serves them from the resident buffer — zero DMA), pref[base+1]
+        # the per-(slot, row-block) activity gate read below.  Exact by
+        # construction: a gated-off block's send words are all zero, so
+        # its OR contribution was zero anyway.
+        yact_ref = pref[base + 1]
     if census:
         # Per-plane honest-column masks (int32[W] scalar prefetch) for
         # the in-kernel coverage census; rides directly after the
-        # overlay tables, before the optional fault prefetch.
-        hmask_ref = pref[3 if masked else 2]
+        # overlay/skip tables, before the optional fault prefetch.
+        hmask_ref = pref[base + (2 if skipped else 0)]
     if faulty:
         # Fault-plane scalar prefetch (faults.kernel_meta): gbase gives
         # each block's first GLOBAL row id (the liveness pass's shard-
@@ -165,6 +175,11 @@ def _pass_kernel(pull: bool, n_planes: int, fanout: int, masked: bool,
         lane = jax.lax.broadcasted_iota(jnp.int32, (blk, LANES), 1)
         part_ok = ((lane & gmask) == (col & gmask)) | (fmeta_ref[4] == 0)
         mask = mask & keep & part_ok
+    if skipped:
+        # dead sender block this round: the resident y buffer holds a
+        # STALE block (the remap pinned the index), so the gate — not
+        # the data — makes the contribution zero
+        mask = mask & (yact_ref[d, pl.program_id(0)] != 0)
     if masked:
         okv = jnp.take_along_axis(
             pltpu.roll(ok_ref[:], blk - subrolls_ref[d], axis=0),
@@ -229,6 +244,8 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
                 census_hmask: jax.Array | None = None,
                 fault_meta: jax.Array | None = None,
                 gbase: jax.Array | None = None,
+                yidx: jax.Array | None = None,
+                yact: jax.Array | None = None,
                 rowblk: int = 512,
                 interpret: bool = False):
     """One OR-accumulated D-slot pass over W message planes.
@@ -291,6 +308,19 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
                 link transfer is kept iff its integer hash clears the
                 threshold AND the partition gate passes — computed
                 in-register (no HBM mask tensor), shard-invariant.
+    ``yidx``/``yact`` — OPTIONAL frontier block-skip (int32[D, T] each,
+                both or neither; built by :func:`skip_tables`):
+                ``yidx`` REPLACES the y index rule — dead sender blocks
+                (all-zero send words this round) are remapped to the
+                previous grid step's index so the pipeline re-serves
+                the resident buffer instead of issuing a DMA, and
+                ``yact[d, t]`` gates their (stale) contribution to
+                zero.  Bitwise-exact by construction: a skipped block's
+                real words are all zero, so its OR contribution was
+                zero on the dense path too.  Composes with every other
+                variant (the fused path's ``src_ok`` block rides the
+                same remapped index, so no extra DMA is issued for it
+                either).
     Returns int32[W, R, 128]: words each peer hears this pass — or the
     pair ``(new, seen')`` when ``seen`` is given.
     """
@@ -306,6 +336,7 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
     finalize = seen is not None
     census = census_hmask is not None
     faulty = fault_meta is not None
+    skipped = yidx is not None
     if finalize:
         assert rmask is not None, "in-kernel seen-update needs rmask"
     if census:
@@ -315,23 +346,39 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
     if faulty:
         assert gbase is not None, "the fault gate needs gbase"
         assert gbase.shape == (T,), (gbase.shape, T)
-    # Index maps take ``*_`` so the optional fault prefetch operands
-    # (gbase, fault_meta — appended below) never change their arity.
+    if skipped:
+        assert yact is not None, "block skipping needs both yidx and yact"
+        assert yidx.shape == (D, T), (yidx.shape, (D, T))
+        assert yact.shape == (D, T), (yact.shape, (D, T))
+    # Index maps take ``*_`` so the optional skip/census/fault prefetch
+    # operands (appended below) never change their arity.
     if fused:
         assert src_ok is not None, "block-perm pass needs the src_ok mask"
         assert ytab.shape == (D, T), (ytab.shape, (D, T))
         n_pref = 3
         prefetch = (rolls, subrolls, ytab)
-        y_map = lambda t, d, k, s, yt, *_: (0, yt[d, t], 0)
+        if skipped:
+            # the remap table already composes perm∘roll (it was built
+            # FROM ytab), so it simply replaces ytab in the y/ok maps
+            y_map = lambda t, d, k, s, yt, yi, *_: (0, yi[d, t], 0)
+            ok_map = lambda t, d, k, s, yt, yi, *_: (yi[d, t], 0)
+        else:
+            y_map = lambda t, d, k, s, yt, *_: (0, yt[d, t], 0)
+            ok_map = lambda t, d, k, s, yt, *_: (yt[d, t], 0)
         tab_map = lambda t, d, k, s, yt, *_: (d, t, 0)
         row_map = lambda t, d, k, s, yt, *_: (t, 0)
-        ok_map = lambda t, d, k, s, yt, *_: (yt[d, t], 0)
     else:
         n_pref = 2
         prefetch = (rolls, subrolls)
-        y_map = lambda t, d, k, s, *_: (0, (t + k[d]) % Ty, 0)
+        if skipped:
+            y_map = lambda t, d, k, s, yi, *_: (0, yi[d, t], 0)
+        else:
+            y_map = lambda t, d, k, s, *_: (0, (t + k[d]) % Ty, 0)
         tab_map = lambda t, d, k, s, *_: (d, t, 0)
         row_map = lambda t, d, k, s, *_: (t, 0)
+    if skipped:
+        prefetch = prefetch + (yidx, yact)
+        n_pref += 2
     if census:
         # int32[W] plane masks — scalar prefetch (SMEM), read per plane
         # in the finalize block.  Appended BEFORE the fault operands so
@@ -393,7 +440,7 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
     out = pl.pallas_call(
         functools.partial(_pass_kernel, pull, W, fanout, fused,
                           acc_init is not None, finalize, census, faulty,
-                          n_pref),
+                          skipped, n_pref),
         grid_spec=grid_spec,
         out_shape=out_shape,
         interpret=interpret,
@@ -646,8 +693,40 @@ def liveness_pass(y_alive: jax.Array, colidx: jax.Array,
     )(*prefetch, y_alive, colidx, strikes, gate)
 
 
+def skip_tables(idx_raw: jax.Array, active: jax.Array
+                ) -> tuple[jax.Array, jax.Array]:
+    """(yidx, yact) for :func:`gossip_pass`'s frontier block-skip from
+    the pass's raw y index table and a per-y-block activity mask.
+
+    ``idx_raw``  int32[T, D] — the index the BlockSpec map would have
+                 produced at grid step (t, d): ``(t + rolls[d]) % Ty``
+                 on row-perm overlays, ``ytab[d, t]`` on block-perm
+                 ones (callers build it with plain jnp broadcasting).
+    ``active``   bool[Ty]    — y blocks with ANY nonzero send word this
+                 round.  Any mask that is conservative (never marks a
+                 nonzero block dead) keeps the pass bitwise-exact; the
+                 engines derive it from the frontier planes directly.
+
+    Dead steps are remapped to the raw index of the last ACTIVE step in
+    grid order (t-major, d innermost — the same order the grid walks),
+    so their index never CHANGES between steps and the pallas pipeline
+    issues no DMA for them; steps before the first active one pin to
+    step 0's index, which the activity gate zeroes anyway.  Runs on
+    device (the activity is a traced per-round value) — a cummax over
+    T*D elements, negligible beside one plane op."""
+    T, D = idx_raw.shape
+    seq = idx_raw.reshape(-1)
+    act_seq = jnp.take(active, seq)
+    steps = jnp.arange(T * D, dtype=jnp.int32)
+    last = jax.lax.cummax(jnp.where(act_seq, steps, -1))
+    remap = jnp.take(seq, jnp.maximum(last, 0))
+    return (remap.reshape(T, D).T.astype(jnp.int32),
+            act_seq.reshape(T, D).T.astype(jnp.int32))
+
+
 def stream_plan(rolls, t_blocks: int, ty_blocks: int | None = None,
-                ytab=None, n_slots: int | None = None) -> dict:
+                ytab=None, n_slots: int | None = None,
+                active=None) -> dict:
     """Replay one (T row-blocks x D slots) pass's DMA-descriptor
     sequence on the host — the traffic model's ground truth for what
     the grid actually streams, derived from the SAME index-map rules
@@ -666,26 +745,35 @@ def stream_plan(rolls, t_blocks: int, ty_blocks: int | None = None,
                   calibrated partial-reuse interpolation)
       ``tab``     per-(row-block, slot) int8 tables (colidx): T * D
       ``row``     d-constant per-row-block planes (gate/rmask/...): T
+      ``y_skip``  grid steps the frontier block-skip gated off (0
+                  without ``active``)
 
     ``n_slots`` restricts the replay to the first n slots (the
     pull-window grid); ``ty_blocks`` covers the sharded case where the
-    y planes span more blocks than the local output grid."""
+    y planes span more blocks than the local output grid; ``active``
+    (bool per y block) replays :func:`skip_tables`'s remap rule — a
+    dead step keeps the previous step's index, so it never fetches."""
     rolls = np.asarray(rolls)
     D = len(rolls) if n_slots is None else n_slots
     T = t_blocks
     Ty = t_blocks if ty_blocks is None else ty_blocks
     yt = None if ytab is None else np.asarray(ytab)
+    act = None if active is None else np.asarray(active)
     fetches = 0
+    skipped = 0
     last = None
     for t in range(T):
         for d in range(D):
             i = (int(yt[d, t]) if yt is not None
                  else int((t + rolls[d]) % Ty))
+            if act is not None and not act[i]:
+                skipped += 1          # index pinned to ``last``: no DMA
+                continue
             if i != last:
                 fetches += 1
                 last = i
     return {"y": fetches, "y_naive": T * D, "tab": T * D, "row": T,
-            "grid": (T, D)}
+            "y_skip": skipped, "grid": (T, D)}
 
 
 def neighbor_ids(perm, rolls, subrolls, colidx, *, rowblk: int = 512):

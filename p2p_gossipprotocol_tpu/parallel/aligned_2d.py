@@ -38,7 +38,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator, AlignedState,
-                                            AlignedTopology, aligned_round)
+                                            AlignedTopology, FrontierCarry,
+                                            aligned_round)
 from p2p_gossipprotocol_tpu.liveness import ChurnConfig
 from p2p_gossipprotocol_tpu.parallel.aligned_sharded import _topo_spec
 from p2p_gossipprotocol_tpu.parallel.mesh import (PEER_AXIS,
@@ -96,6 +97,12 @@ class Aligned2DShardedSimulator:
     #: plane-independent), so every msg shard computes bit-identical
     #: gates and the 2-D engine inherits the parity contract unchanged.
     faults: object | None = None
+    #: frontier-sparse rounds: each msg shard runs the delta exchange
+    #: over its OWN plane slice (the replica shards over the msg axis);
+    #: the regime signal reduces over BOTH axes so every device takes
+    #: the same branch of the compiled conditional.
+    frontier_mode: int = 0
+    frontier_threshold: float = None  # type: ignore[assignment]
     seed: int = 0
     interpret: bool | None = None
 
@@ -105,6 +112,8 @@ class Aligned2DShardedSimulator:
         self.n_msg_shards, self.n_peer_shards = self.mesh.devices.shape
         # The unsharded engine IS the semantics (same discipline as the
         # 1-D engine): validation, init_state, masks come from it.
+        fr_kw = ({} if self.frontier_threshold is None
+                 else {"frontier_threshold": self.frontier_threshold})
         self._inner = AlignedSimulator(
             topo=self.topo, n_msgs=self.n_msgs, mode=self.mode,
             fanout=self.fanout, churn=self.churn,
@@ -114,10 +123,13 @@ class Aligned2DShardedSimulator:
             message_stagger=self.message_stagger,
             fuse_update=self.fuse_update,
             pull_window=self.pull_window, faults=self.faults,
+            frontier_mode=self.frontier_mode, **fr_kw,
             seed=self.seed,
             interpret=self.interpret)
         self.churn = self._inner.churn
         self.interpret = self._inner.interpret
+        self.frontier_threshold = self._inner.frontier_threshold
+        self._frontier = self._inner._frontier_delta
         self._liveness = self._inner._liveness
         W = self._inner.n_words
         if W % self.n_msg_shards:
@@ -156,7 +168,37 @@ class Aligned2DShardedSimulator:
         return jax.device_put(topo, shardings)
 
     # ------------------------------------------------------------------
-    def _step_local(self, state: AlignedState, topo: AlignedTopology):
+    def init_frontier(self, state: AlignedState) -> FrontierCarry | None:
+        """Frontier carry on the 2-D mesh: the replica holds this msg
+        shard's plane slice over ALL global rows (sharded over the msg
+        axis, replicated over the peer axis).  Initialized from the
+        current seen planes — exact for fresh and resumed states alike
+        (see the 1-D engine's init_frontier)."""
+        if not self._frontier:
+            return None
+        replica = byz_g = None
+        if self.mode in ("pull", "pushpull"):
+            replica = jax.device_put(
+                state.seen_w,
+                NamedSharding(self.mesh, P(MSG_AXIS, None, None)))
+        if self.topo.ytab is None:
+            # static byzantine draw: one gather at init (peer-global,
+            # msg-independent — replicated over the whole mesh)
+            byz_g = jax.device_put(
+                state.byz_w, NamedSharding(self.mesh, P()))
+        return FrontierCarry(replica_w=replica, byz_g=byz_g,
+                             regime=jnp.int32(0))
+
+    def _fr_spec(self) -> FrontierCarry:
+        return FrontierCarry(
+            replica_w=(P(MSG_AXIS, None, None)
+                       if self.mode in ("pull", "pushpull") else None),
+            byz_g=P() if self.topo.ytab is None else None,
+            regime=P())
+
+    # ------------------------------------------------------------------
+    def _step_local(self, state: AlignedState, topo: AlignedTopology,
+                    fr: FrontierCarry | None = None):
         rows_l = state.seen_w.shape[1]
         pidx = jax.lax.axis_index(PEER_AXIS)
         grow0 = pidx * rows_l
@@ -169,6 +211,10 @@ class Aligned2DShardedSimulator:
                                       (w_local,))
         jmask = jax.lax.dynamic_slice(self._inner._junk_mask, (w0,),
                                       (w_local,))
+        fr_kw = ({} if fr is None else dict(
+            fr=fr, fr_axis=PEER_AXIS,
+            fr_pmax_axes=(MSG_AXIS, PEER_AXIS),
+            fr_shards=self.n_peer_shards))
         return aligned_round(
             self._inner, state, topo, grows=grows, t_off=t_off,
             gather=lambda x: jax.lax.all_gather(x, PEER_AXIS,
@@ -177,7 +223,8 @@ class Aligned2DShardedSimulator:
             reduce=lambda x: jax.lax.psum(x, PEER_AXIS),
             msg_reduce=lambda x: jax.lax.psum(x, (MSG_AXIS, PEER_AXIS)),
             honest_mask=hmask, junk_mask=jmask, w_off=w0,
-            msg_only_reduce=lambda x: jax.lax.psum(x, MSG_AXIS))
+            msg_only_reduce=lambda x: jax.lax.psum(x, MSG_AXIS),
+            **fr_kw)
 
     # ------------------------------------------------------------------
     def run(self, rounds: int, state: AlignedState | None = None,
@@ -191,33 +238,55 @@ class Aligned2DShardedSimulator:
 
         state = self.init_state() if state is None else state
         topo = self.shard_topo(topo)
+        fr = self.init_frontier(state)
         if rounds not in self._run_cache:
             st_spec = _state_spec(self._liveness)
             tp_spec = _topo_spec(self.topo)
             metric_spec = {k: P() for k in ("coverage", "deliveries",
                                             "frontier_size", "live_peers",
                                             "evictions", "redeliveries")}
+            if fr is not None:
+                metric_spec.update(fr_sparse=P(), fr_words=P())
 
-            def scanned(st, tp):
-                def body(carry, _):
-                    s, t = carry
-                    s, t, metrics = self._step_local(s, t)
-                    return (s, t), metrics
-                return jax.lax.scan(body, (st, tp), None, length=rounds)
+            if fr is None:
+                def scanned(st, tp):
+                    def body(carry, _):
+                        s, t = carry
+                        s, t, metrics = self._step_local(s, t)
+                        return (s, t), metrics
+                    return jax.lax.scan(body, (st, tp), None,
+                                        length=rounds)
 
+                in_specs = (st_spec, tp_spec)
+            else:
+                def scanned(st, tp, f):
+                    def body(carry, _):
+                        s, t, f = carry
+                        s, t, metrics, f = self._step_local(s, t, f)
+                        return (s, t, f), metrics
+                    (st, tp, _), ys = jax.lax.scan(
+                        body, (st, tp, f), None, length=rounds)
+                    return (st, tp), ys
+
+                in_specs = (st_spec, tp_spec, self._fr_spec())
             self._run_cache[rounds] = jax.jit(shard_map_compat(
                 scanned, mesh=self.mesh,
-                in_specs=(st_spec, tp_spec),
+                in_specs=in_specs,
                 out_specs=((st_spec, tp_spec), metric_spec)))
         fn = self._run_cache[rounds]
+        args = (state, topo) if fr is None else (state, topo, fr)
         if warmup:
-            (w_state, _), _ = fn(state, topo)
+            (w_state, _), _ = fn(*args)
             int(jax.device_get(w_state.round))
         t0 = _time.perf_counter()
-        (state, topo), ys = fn(state, topo)
+        (state, topo), ys = fn(*args)
         int(jax.device_get(state.round))
         wall = _time.perf_counter() - t0
-        return SimResult.from_metrics(state, topo, ys, wall)
+        res = SimResult.from_metrics(state, topo, ys, wall)
+        if fr is not None:
+            res.fr_sparse = np.asarray(ys["fr_sparse"])
+            res.fr_words = np.asarray(ys["fr_words"])
+        return res
 
     def run_to_coverage(self, target: float = 0.99, max_rounds: int = 256,
                         state: AlignedState | None = None,
@@ -234,6 +303,7 @@ class Aligned2DShardedSimulator:
             raise ValueError("check_every must be >= 1")
         state = self.init_state() if state is None else state
         topo = self.shard_topo(topo)
+        fr = self.init_frontier(state)
         cache_key = ("cov", target, max_rounds, check_every)
         if cache_key not in self._run_cache:
             st_spec = _state_spec(self._liveness)
@@ -246,19 +316,28 @@ class Aligned2DShardedSimulator:
                                           self.message_stagger)
             looped = build_coverage_loop(
                 self._step_local, target=target, max_rounds=max_rounds,
-                check_every=check_every, sched_end=sched_end)
+                check_every=check_every, sched_end=sched_end,
+                with_extra=fr is not None)
 
+            if fr is None:
+                in_specs = (st_spec, tp_spec)
+                out_specs = (st_spec, tp_spec, P())
+            else:
+                in_specs = (st_spec, tp_spec, self._fr_spec())
+                out_specs = (st_spec, tp_spec, self._fr_spec(), P())
             fn = jax.jit(shard_map_compat(
                 looped, mesh=self.mesh,
-                in_specs=(st_spec, tp_spec),
-                out_specs=(st_spec, tp_spec, P())))
-            self._run_cache[cache_key] = fn.lower(state, topo).compile()
+                in_specs=in_specs, out_specs=out_specs))
+            args = (state, topo) if fr is None else (state, topo, fr)
+            self._run_cache[cache_key] = fn.lower(*args).compile()
         fn_c = self._run_cache[cache_key]
+        args = (state, topo) if fr is None else (state, topo, fr)
         if warmup:
-            out = fn_c(state, topo)
+            out = fn_c(*args)
             jax.device_get(out[0].round)
         t0 = _time.perf_counter()
-        st, tp, cov = fn_c(state, topo)
+        out = fn_c(*args)
+        st, tp = out[0], out[1]
         rounds_run = int(jax.device_get(st.round))
         wall = _time.perf_counter() - t0
         return st, tp, rounds_run, wall

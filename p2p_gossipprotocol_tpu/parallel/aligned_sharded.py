@@ -39,7 +39,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator, AlignedState,
-                                            AlignedTopology, aligned_round)
+                                            AlignedTopology, FrontierCarry,
+                                            aligned_round)
 from p2p_gossipprotocol_tpu.aligned_sir import (AlignedSIRSimulator,
                                                 AlignedSIRState,
                                                 aligned_sir_round)
@@ -93,6 +94,13 @@ class AlignedShardedSimulator:
     #: every fault mask per GLOBAL row / in-kernel global-id hash, so a
     #: faulted sharded run stays bitwise-equal to the unsharded engine.
     faults: object | None = None
+    #: frontier-sparse rounds (aligned.AlignedSimulator.frontier_mode):
+    #: on this engine the feature additionally replaces the per-round
+    #: dense all_gather of the send planes with the delta-compressed
+    #: exchange + per-chip seen replica (aligned._frontier_exchange) —
+    #: bitwise-identical to the dense path, regime switch included.
+    frontier_mode: int = 0
+    frontier_threshold: float = None  # type: ignore[assignment]
     seed: int = 0
     interpret: bool | None = None
 
@@ -108,6 +116,8 @@ class AlignedShardedSimulator:
                 f"build_aligned(..., n_shards={self.n_shards})")
         # The unsharded engine IS the semantics: reuse its validation,
         # init_state math and derived masks wholesale.
+        fr_kw = ({} if self.frontier_threshold is None
+                 else {"frontier_threshold": self.frontier_threshold})
         self._inner = AlignedSimulator(
             topo=self.topo, n_msgs=self.n_msgs, mode=self.mode,
             fanout=self.fanout,
@@ -118,11 +128,14 @@ class AlignedShardedSimulator:
             fuse_update=self.fuse_update,
             pull_window=self.pull_window,
             faults=self.faults,
+            frontier_mode=self.frontier_mode, **fr_kw,
             seed=self.seed, interpret=self.interpret)
         self.churn = self._inner.churn
         self.interpret = self._inner.interpret
+        self.frontier_threshold = self._inner.frontier_threshold
         self._liveness = self._inner._liveness
         self._n_honest = self._inner._n_honest
+        self._frontier = self._inner._frontier_delta
         self._run_cache: dict = {}
         self._loop_cache: dict = {}
 
@@ -152,25 +165,61 @@ class AlignedShardedSimulator:
         return jax.device_put(topo, shardings)
 
     # ------------------------------------------------------------------
-    def _step_local(self, state: AlignedState, topo: AlignedTopology
-                    ) -> tuple[AlignedState, AlignedTopology, dict]:
+    def init_frontier(self, state: AlignedState) -> FrontierCarry | None:
+        """The frontier-sparse exchange's scan carry (None when the
+        feature is off).  The replica initializes from the CURRENT seen
+        planes — exact for a fresh run (replica|frontier = seen at
+        round 0, where frontier == seen) and for a checkpoint resume
+        alike, which is why FrontierCarry never needs to be serialized
+        (resume restarts dense and re-converges to the same regime on
+        its own; the trajectory is regime-independent by the bitwise
+        contract).  Pure push carries no replica at all — no pass reads
+        global seen."""
+        if not self._frontier:
+            return None
+        replica = byz_g = None
+        if self.mode in ("pull", "pushpull"):
+            replica = jax.device_put(
+                state.seen_w, NamedSharding(self.mesh, P()))
+        if self.topo.ytab is None:
+            # static byzantine draw: gather its mask plane ONCE (the
+            # fused path masks through src_ok instead)
+            byz_g = jax.device_put(
+                state.byz_w, NamedSharding(self.mesh, P()))
+        return FrontierCarry(replica_w=replica, byz_g=byz_g,
+                             regime=jnp.int32(0))
+
+    def _fr_spec(self) -> FrontierCarry:
+        return FrontierCarry(
+            replica_w=(P() if self.mode in ("pull", "pushpull")
+                       else None),
+            byz_g=P() if self.topo.ytab is None else None,
+            regime=P())
+
+    # ------------------------------------------------------------------
+    def _step_local(self, state: AlignedState, topo: AlignedTopology,
+                    fr: FrontierCarry | None = None):
         """One full round on this shard's row blocks — the SAME
         aligned_round as the single-chip engine, with the mesh plugged in:
         global row ids / roll offsets from the shard's position, gather =
         all_gather (globalizes the row-permuted words the kernels read),
-        reduce = psum."""
+        reduce = psum.  With ``fr`` the round runs the frontier-sparse
+        exchange and returns the 4-tuple including the updated carry."""
         rows_l = state.seen_w.shape[1]          # local rows
         sidx = jax.lax.axis_index(AXIS)
         grow0 = sidx * rows_l
         grows = grow0 + jnp.arange(rows_l, dtype=jnp.int32)
         t_off = (grow0 // topo.rowblk).astype(jnp.int32)
+        fr_kw = ({} if fr is None else dict(
+            fr=fr, fr_axis=AXIS, fr_pmax_axes=(AXIS,),
+            fr_shards=self.n_shards))
         return aligned_round(
             self._inner, state, topo, grows=grows, t_off=t_off,
             # gather the ROWS axis (ndim-2): axis 0 of the 2D alive
             # words, axis 1 of the 3D [W, rows, 128] message planes
             gather=lambda x: jax.lax.all_gather(x, AXIS, axis=x.ndim - 2,
                                                 tiled=True),
-            reduce=lambda x: jax.lax.psum(x, AXIS))
+            reduce=lambda x: jax.lax.psum(x, AXIS), **fr_kw)
 
     # ------------------------------------------------------------------
     def _specs(self):
@@ -179,6 +228,8 @@ class AlignedShardedSimulator:
         metric = {k: P() for k in ("coverage", "deliveries",
                                    "frontier_size", "live_peers",
                                    "evictions", "redeliveries")}
+        if self._frontier:
+            metric.update(fr_sparse=P(), fr_words=P())
         return st, tp, metric
 
     def run(self, rounds: int, state: AlignedState | None = None,
@@ -196,29 +247,51 @@ class AlignedShardedSimulator:
 
         state = self.init_state() if state is None else state
         topo = self.shard_topo(topo)
+        fr = self.init_frontier(state)
         if rounds not in self._run_cache:
             st_spec, tp_spec, metric_spec = self._specs()
 
-            def scanned(st, tp):
-                def body(carry, _):
-                    s, t = carry
-                    s, t, metrics = self._step_local(s, t)
-                    return (s, t), metrics
-                return jax.lax.scan(body, (st, tp), None, length=rounds)
+            if fr is None:
+                def scanned(st, tp):
+                    def body(carry, _):
+                        s, t = carry
+                        s, t, metrics = self._step_local(s, t)
+                        return (s, t), metrics
+                    return jax.lax.scan(body, (st, tp), None,
+                                        length=rounds)
 
+                in_specs = (st_spec, tp_spec)
+            else:
+                def scanned(st, tp, f):
+                    def body(carry, _):
+                        s, t, f = carry
+                        s, t, metrics, f = self._step_local(s, t, f)
+                        return (s, t, f), metrics
+                    (st, tp, _), ys = jax.lax.scan(
+                        body, (st, tp, f), None, length=rounds)
+                    return (st, tp), ys
+
+                in_specs = (st_spec, tp_spec, self._fr_spec())
             self._run_cache[rounds] = jax.jit(shard_map_compat(
                 scanned, mesh=self.mesh,
-                in_specs=(st_spec, tp_spec),
+                in_specs=in_specs,
                 out_specs=((st_spec, tp_spec), metric_spec)))
         fn = self._run_cache[rounds]
+        args = (state, topo) if fr is None else (state, topo, fr)
         if warmup:
-            (w_state, _), _ = fn(state, topo)
+            (w_state, _), _ = fn(*args)
             int(jax.device_get(w_state.round))
         t0 = _time.perf_counter()
-        (state, topo), ys = fn(state, topo)
+        (state, topo), ys = fn(*args)
         int(jax.device_get(state.round))    # forces completion
         wall = _time.perf_counter() - t0
-        return SimResult.from_metrics(state, topo, ys, wall)
+        res = SimResult.from_metrics(state, topo, ys, wall)
+        if fr is not None:
+            # exchange diagnostics (regime per round, worst changed-word
+            # count) — not SimResult fields, attached for the A/B
+            res.fr_sparse = np.asarray(ys["fr_sparse"])
+            res.fr_words = np.asarray(ys["fr_words"])
+        return res
 
     def run_to_coverage(self, target: float = 0.99, max_rounds: int = 256,
                         state: AlignedState | None = None,
@@ -239,6 +312,7 @@ class AlignedShardedSimulator:
             raise ValueError("check_every must be >= 1")
         state = self.init_state() if state is None else state
         topo = self.shard_topo(topo)
+        fr = self.init_frontier(state)
         cache_key = (target, max_rounds, check_every)
         if cache_key not in self._loop_cache:
             st_spec, tp_spec, _ = self._specs()
@@ -250,19 +324,28 @@ class AlignedShardedSimulator:
                                           self.message_stagger)
             looped = build_coverage_loop(
                 self._step_local, target=target, max_rounds=max_rounds,
-                check_every=check_every, sched_end=sched_end)
+                check_every=check_every, sched_end=sched_end,
+                with_extra=fr is not None)
 
+            if fr is None:
+                in_specs = (st_spec, tp_spec)
+                out_specs = (st_spec, tp_spec, P())
+            else:
+                in_specs = (st_spec, tp_spec, self._fr_spec())
+                out_specs = (st_spec, tp_spec, self._fr_spec(), P())
             fn = jax.jit(shard_map_compat(
                 looped, mesh=self.mesh,
-                in_specs=(st_spec, tp_spec),
-                out_specs=(st_spec, tp_spec, P())))
-            self._loop_cache[cache_key] = fn.lower(state, topo).compile()
+                in_specs=in_specs, out_specs=out_specs))
+            args = (state, topo) if fr is None else (state, topo, fr)
+            self._loop_cache[cache_key] = fn.lower(*args).compile()
         fn_c = self._loop_cache[cache_key]
+        args = (state, topo) if fr is None else (state, topo, fr)
         if warmup:
-            out = fn_c(state, topo)
+            out = fn_c(*args)
             jax.device_get(out[0].round)
         t0 = _time.perf_counter()
-        st, tp, cov = fn_c(state, topo)
+        out = fn_c(*args)
+        st, tp = out[0], out[1]
         rounds_run = int(jax.device_get(st.round))
         wall = _time.perf_counter() - t0
         return st, tp, rounds_run, wall

@@ -103,7 +103,8 @@ def stagger_sched_end(n_honest: int, stagger: int) -> int:
 
 
 def build_coverage_loop(step_fn, *, target: float, max_rounds: int,
-                        check_every: int, sched_end):
+                        check_every: int, sched_end,
+                        with_extra: bool = False):
     """ONE definition of the run-to-coverage device loop, shared by
     every engine — edges (sim.Simulator), single-chip aligned, the 1-D
     sharded pair, and the 2-D mesh — which differ only in ``step_fn``
@@ -111,6 +112,13 @@ def build_coverage_loop(step_fn, *, target: float, max_rounds: int,
     ``looped(state, topo) -> (state, topo, cov)``; lives here (with
     :func:`stagger_sched_end`, its only companion input) so no engine
     has to import a sibling engine for it.
+
+    ``with_extra=True`` threads one more carry leaf through the loop —
+    the sharded engines' frontier-sparse exchange state
+    (aligned.FrontierCarry), whose regime hysteresis must live inside
+    the compiled loop: ``step_fn`` becomes ``(state, topo, extra) ->
+    (state, topo, metrics, extra)`` and ``looped(state, topo, extra)
+    -> (state, topo, extra, cov)``.
 
     Semantics (pinned by every engine's parity tests): stop when the
     census coverage reaches ``target`` AND the stagger schedule has
@@ -122,42 +130,53 @@ def build_coverage_loop(step_fn, *, target: float, max_rounds: int,
     chunked loop only takes chunks that fit, and a per-round tail loop
     finishes the remainder exactly."""
 
-    def looped(st, tp):
+    def step(st, tp, ex):
+        if with_extra:
+            st, tp, metrics, ex = step_fn(st, tp, ex)
+        else:
+            st, tp, metrics = step_fn(st, tp)
+        return st, tp, ex, metrics
+
+    def looped(st, tp, extra=None):
         def want_more(carry):
-            st, tp, cov = carry
+            st, tp, ex, cov = carry
             return (cov < target) | (st.round < sched_end)
 
         def round_body(carry):
-            st, tp, _ = carry
-            st, tp, metrics = step_fn(st, tp)
-            return st, tp, metrics["coverage"]
+            st, tp, ex, _ = carry
+            st, tp, ex, metrics = step(st, tp, ex)
+            return st, tp, ex, metrics["coverage"]
+
+        def done(carry):
+            st, tp, ex, cov = carry
+            return (st, tp, ex, cov) if with_extra else (st, tp, cov)
 
         if check_every == 1:
-            return jax.lax.while_loop(
+            return done(jax.lax.while_loop(
                 lambda c: want_more(c) & (c[0].round < max_rounds),
-                round_body, (st, tp, jnp.float32(0)))
+                round_body, (st, tp, extra, jnp.float32(0))))
 
         def chunk_body(carry):
-            st, tp, _ = carry
+            st, tp, ex, _ = carry
 
             def chunk(c, _):
-                s, t = c
-                s, t, metrics = step_fn(s, t)
-                return (s, t), metrics["coverage"]
+                s, t, e = c
+                s, t, e, metrics = step(s, t, e)
+                return (s, t, e), metrics["coverage"]
 
-            (st, tp), covs = jax.lax.scan(
-                chunk, (st, tp), None, length=check_every)
-            return st, tp, covs[-1]
+            (st, tp, ex), covs = jax.lax.scan(
+                chunk, (st, tp, ex), None, length=check_every)
+            return st, tp, ex, covs[-1]
 
         # chunked fast path: only chunks that fit under the cap
         carry = jax.lax.while_loop(
             lambda c: (want_more(c)
                        & (c[0].round + check_every <= max_rounds)),
-            chunk_body, (st, tp, jnp.float32(0)))
+            chunk_body, (st, tp, extra, jnp.float32(0)))
         # per-round tail (< K rounds) keeps max_rounds exact
-        return jax.lax.while_loop(
+        return done(jax.lax.while_loop(
             lambda c: want_more(c) & (c[0].round < max_rounds),
-            round_body, carry)
+            round_body, carry))
 
     return looped
 

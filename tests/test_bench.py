@@ -109,9 +109,13 @@ def test_bench_fallback_omits_steady_and_carries_tpu_pointer():
     assert proc.returncode == 0, proc.stderr
     assert rec["fallback"] is True
     assert "steady_ms_per_round" not in rec
-    tpu = rec.get("tpu_result_this_round")
+    tpu = rec.get("last_recorded_tpu_result")
     assert tpu is not None and tpu["value"] > 0
     assert tpu["device"].startswith("TPU")
+    # provenance (ADVICE r5): the pointer must say WHERE the number
+    # came from, so a stale committed headline can't pass as fresh
+    assert tpu["source"] in ("working-tree", "HEAD")
+    assert tpu.get("recorded_at")
 
 
 def test_bench_stagger_and_block_perm_knobs():
