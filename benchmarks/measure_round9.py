@@ -1,0 +1,131 @@
+"""Round-9 measurement: self-healing recovery — MTTR and parity.
+
+Drives the chaos harness (benchmarks/chaos_rehearsal.py) across the
+failure grid the supervision plane claims to cover, one JSON row per
+scenario (with a ``parity_ok`` column on EVERY row — a recovery whose
+resumed trajectory differs from the uninterrupted survivor-layout run
+is not a recovery):
+
+* ``chaos_sigkill_holder`` — a device-owning host dies outright;
+  detection is immediate (waitpid), recovery shrinks 2→1 workers.
+* ``chaos_sigkill_chief``  — the computing rank dies; a NEW chief is
+  elected (lowest surviving rank) and resumes.
+* ``chaos_sigstop_chief``  — the computing rank wedges without dying
+  (the hung-collective / SIGSTOP case); detection is the heartbeat
+  DEADLINE, so the recorded detect_s ≈ supervise_deadline_s is the
+  price of hang detection.
+* ``supervised_clean``     — no chaos: the supervised multihost
+  rehearsal itself (spmd=auto with recorded fallback), so the rows
+  also pin the no-failure overhead of running under the health plane.
+
+Run (watchdog chain step measure_round9):
+    PYTHONPATH=/root/repo python benchmarks/measure_round9.py
+Appends to GOSSIP_R9_OUT (default benchmarks/results/round9_tpu.jsonl
+on TPU, round9_cpu.jsonl elsewhere), resuming per-config like the
+round-4..8 drivers.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+OUT = None
+
+#: the chaos grid: (config name, chaos_rehearsal args)
+SCENARIOS = [
+    ("chaos_sigkill_holder",
+     ["--seed", "0", "--kill", "sigkill", "--victim", "holder"]),
+    ("chaos_sigkill_chief",
+     ["--seed", "1", "--kill", "sigkill", "--victim", "chief"]),
+    ("chaos_sigstop_chief",
+     ["--seed", "2", "--kill", "sigstop", "--victim", "chief"]),
+]
+
+
+def _out_path(cpu: bool) -> str:
+    default = os.path.join(HERE, "results",
+                           "round9_cpu.jsonl" if cpu
+                           else "round9_tpu.jsonl")
+    return os.environ.get("GOSSIP_R9_OUT", default)
+
+
+def emit(row):
+    row["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    print(json.dumps(row), flush=True)
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def _landed() -> set:
+    from benchmarks._common import landed
+    return landed(OUT)
+
+
+def run_chaos_scenario(name: str, args: list, done: set) -> None:
+    if name in done:
+        return
+    proc = subprocess.run(
+        [sys.executable, os.path.join(HERE, "chaos_rehearsal.py"),
+         *args, "--quiet"],
+        capture_output=True, text=True, timeout=900)
+    try:
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (IndexError, ValueError):
+        emit({"config": name, "ok": False, "parity_ok": False,
+              "error": (proc.stderr or proc.stdout)[-1500:]})
+        return
+    row["config"] = name          # stable key for the resume set
+    if not (row.get("ok") and row.get("parity_ok")):
+        # failed rows stay retryable on the next window (landed()
+        # skips rows carrying an error field)
+        row["error"] = row.get("reason") or row.get(
+            "parity_detail") or "recovery or parity failed"
+    emit(row)
+
+
+def run_supervised_clean(done: set) -> None:
+    if "supervised_clean" in done:
+        return
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(HERE, "multihost_rehearsal.py"),
+         "--supervise", "--rounds", "16"],
+        capture_output=True, text=True, timeout=900)
+    row = {"config": "supervised_clean",
+           "wall_s": round(time.time() - t0, 2),
+           "rc": proc.returncode,
+           "ok": proc.returncode == 0,
+           "parity_ok": proc.returncode == 0}
+    try:
+        art = json.loads(proc.stdout.strip().splitlines()[-1])
+        row["spmd"] = art.get("spmd")
+        row["attempts"] = art.get("attempts")
+        row["final_coverage"] = (art.get("result") or {}).get(
+            "final_coverage")
+    except (IndexError, ValueError):
+        row["error"] = (proc.stderr or proc.stdout)[-1500:]
+    emit(row)
+
+
+def main() -> int:
+    global OUT
+    # the chaos workers pin their own platform; only the OUT basename
+    # needs to know where we are (no jax import in this driver — the
+    # supervisor discipline)
+    on_tpu = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+    OUT = _out_path(cpu=not on_tpu)
+    done = _landed()
+    run_supervised_clean(done)
+    for name, args in SCENARIOS:
+        run_chaos_scenario(name, args, done)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

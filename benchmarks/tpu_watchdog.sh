@@ -109,7 +109,7 @@ record_fail() {
   fi
 }
 
-STEP_NAMES="bench mosaic_smoke measure_round4 measure_round5 measure_round6 measure_round7 measure_round8 baselines longrun"
+STEP_NAMES="bench mosaic_smoke measure_round4 measure_round5 measure_round6 measure_round7 measure_round8 measure_round9 baselines multihost longrun"
 # Headline first: a short tunnel window must yield the most important
 # artifact.  bench keeps its file contract (ONE parsed line) and only
 # stamps when the line really came from the chip.  longrun is the
@@ -133,7 +133,18 @@ PY" ;;
     measure_round6) echo "python benchmarks/measure_round6.py" ;;
     measure_round7) echo "python benchmarks/measure_round7.py" ;;
     measure_round8) echo "python benchmarks/measure_round8.py" ;;
+    measure_round9) echo "python benchmarks/measure_round9.py" ;;
     baselines)      echo "python benchmarks/run_baselines.py" ;;
+    multihost)
+      # the multi-host step is DELEGATED to the runtime supervisor
+      # (round 9): heartbeat deadlines catch a worker that wedges
+      # mid-window at round granularity (this watchdog's own timeout
+      # is minutes-coarse), a dead/hung worker shrinks the job to the
+      # survivors and resumes the elastic checkpoint, and spmd=auto
+      # records a chief-mode fallback instead of failing the step
+      # where multi-process collectives don't exist
+      echo "python benchmarks/multihost_rehearsal.py --supervise \
+        --rounds 16" ;;
     longrun)
       # resume whenever a committed checkpoint exists — covers both the
       # clean rc-75 salvage AND a window that died mid-run (timeout
@@ -153,7 +164,9 @@ step_tmo() {
     measure_round6) echo 3600 ;;
     measure_round7) echo 3600 ;;
     measure_round8) echo 3600 ;;
+    measure_round9) echo 3600 ;;
     baselines) echo 4800 ;;
+    multihost) echo 1800 ;;
     longrun) echo 1800 ;;
   esac
 }

@@ -116,6 +116,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "bitwise-identically.  A run interrupted by "
                         "SIGINT/SIGTERM salvages a checkpoint and exits "
                         "75 (resumable)")
+    p.add_argument("--supervise", action="store_true",
+                   help="jax mode, engine=aligned: run the scenario as "
+                        "a supervised multi-process job "
+                        "(runtime/supervisor.py): supervise_workers "
+                        "worker processes under the health plane — "
+                        "round-stamped heartbeats, traffic-model-"
+                        "derived deadlines, hung/dead worker "
+                        "detection, and deterministic shrink-to-"
+                        "survivors recovery from the last elastic "
+                        "checkpoint (needs --checkpoint-dir for "
+                        "resume-instead-of-restart).  Config twins: "
+                        "supervise=1 and the supervise_* keys")
     p.add_argument("--metrics-jsonl", default=None, metavar="PATH",
                    help="write per-round metrics as JSONL")
     p.add_argument("--profile-dir", default=None, metavar="DIR",
@@ -304,6 +316,30 @@ def _run_fleet(sweep, cfg, args, rounds) -> int:
             return EX_RESUMABLE
         return 1
     return 0
+
+
+def _run_supervise(cfg: NetworkConfig, args) -> int:
+    """Drive the scenario as a supervised multi-process job
+    (runtime/supervisor.py): launch supervise_workers worker
+    processes, watch heartbeats against traffic-model deadlines, and
+    on a hung/dead worker shrink the mesh to the survivors and resume
+    the last elastic checkpoint.  Prints one summary JSON line with
+    the recovery history and per-recovery MTTR."""
+    from p2p_gossipprotocol_tpu.runtime.supervisor import \
+        supervise_from_config
+
+    rounds = args.rounds or cfg.rounds or 64
+    res = supervise_from_config(
+        cfg, config_path=args.config_file, rounds=rounds,
+        n_peers=args.n_peers, checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every, quiet=args.quiet)
+    print(json.dumps(res.summary()))
+    if res.skipped:
+        # environment impossibility (e.g. forced distributed spmd on a
+        # backend without multi-process collectives) — the rehearsal's
+        # skip convention, not a failure
+        return 3
+    return 0 if res.ok else 1
 
 
 def _report_sir(res, *, n_peers, engine, args, metrics_lib,
@@ -504,6 +540,33 @@ def main(argv: list[str] | None = None) -> int:
               "runtime is the reference's in-memory-only model)",
               file=sys.stderr)
         return 1
+
+    if args.supervise or cfg.supervise:
+        # supervised multi-process run: the supervisor owns the worker
+        # processes; this process never initializes jax (it must stay
+        # killable while a worker wedges in backend init)
+        if cfg.backend != "jax":
+            print("Error: --supervise is a jax-backend feature (the "
+                  "socket runtime is one real peer process)",
+                  file=sys.stderr)
+            return 1
+        if cfg.engine != "aligned":
+            print("Error: --supervise drives the aligned-sharded "
+                  "engine family (set engine=aligned) — its layouts "
+                  "share one RNG schedule, which is what makes "
+                  "shrink-to-survivors resume bitwise "
+                  "(docs/ROBUSTNESS.md)", file=sys.stderr)
+            return 1
+        if cfg.mode == "sir":
+            print("Error: --supervise covers the gossip modes",
+                  file=sys.stderr)
+            return 1
+        if not args.checkpoint_dir and not args.quiet:
+            print("Warning: --supervise without --checkpoint-dir — a "
+                  "recovery restarts the shrunk job from round 0 "
+                  "instead of resuming the last checkpoint",
+                  file=sys.stderr)
+        return _run_supervise(cfg, args)
 
     if not args.quiet:
         print(cfg.to_string())  # main.cpp:48

@@ -165,6 +165,18 @@ _SIM_INT_KEYS = {
     # row as n_peers_requested vs n_peers — never silent).
     "sweep_max_batch": "sweep_max_batch",
     "sweep_pad_peers": "sweep_pad_peers",
+    # Self-healing multi-process runs (runtime/supervisor.py; jax
+    # backend, engine=aligned): supervise=1 launches the run as
+    # supervise_workers worker processes under the health plane —
+    # heartbeat files, per-round deadlines priced from the traffic
+    # model, hung/dead worker detection, and deterministic
+    # shrink-to-survivors recovery from the last elastic checkpoint.
+    # CLI twin: --supervise.
+    "supervise": "supervise",
+    "supervise_workers": "supervise_workers",
+    "supervise_devs_per_proc": "supervise_devs_per_proc",
+    "supervise_max_failures": "supervise_max_failures",
+    "supervise_min_workers": "supervise_min_workers",
 }
 _SIM_FLOAT_KEYS = {
     "er_p": "er_p",
@@ -188,6 +200,12 @@ _SIM_FLOAT_KEYS = {
     # when every shard's changed-word count fits (with hysteresis;
     # aligned.FRONTIER_THRESHOLD_DEFAULT has the derivation).
     "frontier_threshold": "frontier_threshold",
+    # Supervision deadlines (seconds): grace covers launch→first run
+    # heartbeat (backend init + first compile); deadline_s=0 derives
+    # the per-chunk deadline from the worker's traffic model
+    # (runtime.supervisor.chunk_deadline_s).
+    "supervise_grace_s": "supervise_grace_s",
+    "supervise_deadline_s": "supervise_deadline_s",
 }
 _SIM_STR_KEYS = {
     "local_ip": "local_ip",
@@ -214,6 +232,10 @@ _SIM_STR_KEYS = {
     # the per-scenario results table lands.
     "sweep_file": "sweep_file",
     "sweep_results": "sweep_results",
+    # Supervision spmd mode: auto (try jax.distributed, fall back to
+    # the single-process-spmd chief rehearsal where multi-process
+    # collectives don't exist), or force either.
+    "supervise_spmd": "supervise_spmd",
 }
 
 
@@ -307,6 +329,15 @@ class NetworkConfig:
         self.sweep_max_batch = 256       # widest bucket (overflow splits)
         self.sweep_pad_peers = 1         # pad n_peers to powers of two
         self.sweep_target = 0.0          # >0 = early-exit coverage target
+        # Self-healing supervision (runtime/supervisor.py)
+        self.supervise = 0               # 1 = run under the supervisor
+        self.supervise_workers = 2       # worker processes in the job
+        self.supervise_devs_per_proc = 4
+        self.supervise_spmd = "auto"     # auto | distributed | chief
+        self.supervise_grace_s = 180.0   # launch -> first run heartbeat
+        self.supervise_deadline_s = 0.0  # 0 = derive from traffic model
+        self.supervise_max_failures = 0  # 0 = workers - 1
+        self.supervise_min_workers = 1
         self._load_config()
         self._validate_config()
 
@@ -426,9 +457,26 @@ class NetworkConfig:
                   "rounds", "prng_seed", "anti_entropy_interval",
                   "message_stagger", "mesh_devices", "msg_shards",
                   "checkpoint_every", "checkpoint_resume",
-                  "sweep_max_batch", "sweep_pad_peers"):
+                  "sweep_max_batch", "sweep_pad_peers",
+                  "supervise", "supervise_max_failures",
+                  "supervise_grace_s", "supervise_deadline_s"):
             if getattr(self, k) < 0:
                 raise ConfigError(f"{k} must be non-negative")
+        if self.supervise:
+            if self.supervise_workers < 1 \
+                    or self.supervise_devs_per_proc < 1:
+                raise ConfigError(
+                    "supervise_workers/supervise_devs_per_proc must "
+                    "be >= 1")
+            if self.supervise_min_workers < 1 \
+                    or self.supervise_min_workers > self.supervise_workers:
+                raise ConfigError(
+                    "supervise_min_workers must be in "
+                    "[1, supervise_workers]")
+        if self.supervise_spmd not in ("auto", "distributed", "chief"):
+            raise ConfigError(
+                f"Unknown supervise_spmd: {self.supervise_spmd} "
+                "(auto|distributed|chief)")
         if (self.checkpoint_every > 0 or self.checkpoint_resume) \
                 and not self.checkpoint_dir:
             raise ConfigError(

@@ -163,9 +163,14 @@ def config_keys(cfg, n_peers: int | None = None) -> dict:
     and the ``frontier_*`` keys, whose sparse execution path is
     bitwise-identical to the dense one by seen-set monotonicity
     (tests/test_frontier.py), so a checkpoint migrates freely between
-    frontier-sparse and dense readers.  Everything that picks the
-    overlay, the model, the randomness chain, or the fault schedule is
-    included."""
+    frontier-sparse and dense readers.  The ``supervise_*`` keys are
+    likewise excluded: supervision decides WHERE a run executes (how
+    many worker processes, what deadlines), never its trajectory — a
+    checkpoint written under supervision must resume unsupervised and
+    vice versa, and a shrink-to-survivors recovery must not read as
+    fingerprint drift (runtime/supervisor.py).  Everything that picks
+    the overlay, the model, the randomness chain, or the fault
+    schedule is included."""
     return {
         "n_peers": n_peers or cfg.n_peers or len(cfg.seed_nodes),
         "n_messages": cfg.n_messages or cfg.max_message_count,

@@ -257,7 +257,8 @@ class FleetSweep:
         import time
 
         from p2p_gossipprotocol_tpu.utils.checkpoint import (
-            CheckpointError, FingerprintMismatch, _write_atomic)
+            CheckpointError, FingerprintMismatch, _write_atomic,
+            read_manifest)
 
         target = self.target if target is None else target
         fp = self.fingerprint()
@@ -268,17 +269,11 @@ class FleetSweep:
             os.makedirs(checkpoint_dir, exist_ok=True)
             mpath = self._manifest_path(checkpoint_dir)
             if resume:
-                if not os.path.exists(mpath):
-                    raise CheckpointError(
-                        f"sweep resume requested but {checkpoint_dir!r} "
-                        "holds no sweep_manifest.json — refusing to "
-                        "silently start over")
-                with open(mpath) as f:
-                    old = json.load(f)
-                if int(old.get("schema", 0)) > SWEEP_SCHEMA:
-                    raise CheckpointError(
-                        f"sweep manifest schema {old.get('schema')} is "
-                        f"newer than this build's {SWEEP_SCHEMA}")
+                # shared manifest discipline (utils.checkpoint
+                # .read_manifest): missing / unreadable / newer-schema
+                # manifests fail by NAME, same as the solo runner
+                old = read_manifest(mpath, schema_max=SWEEP_SCHEMA,
+                                    what="sweep checkpoint")
                 if old.get("fingerprint") != fp:
                     raise FingerprintMismatch(
                         "sweep checkpoint was written under fingerprint "

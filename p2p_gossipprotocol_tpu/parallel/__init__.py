@@ -28,7 +28,8 @@ from p2p_gossipprotocol_tpu.parallel.aligned_sharded import (
     AlignedShardedSimulator,
     AlignedShardedSIRSimulator,
 )
-from p2p_gossipprotocol_tpu.parallel.mesh import make_mesh
+from p2p_gossipprotocol_tpu.parallel.mesh import (make_mesh,
+                                                  make_survivor_mesh)
 from p2p_gossipprotocol_tpu.parallel.partition import (
     ShardedTopology,
     partition_topology,
@@ -40,6 +41,7 @@ from p2p_gossipprotocol_tpu.parallel.sharded_sim import ShardedSimulator
 __all__ = [
     "make_mesh",
     "make_mesh_2d",
+    "make_survivor_mesh",
     "Aligned2DShardedSimulator",
     "AlignedShardedSimulator",
     "AlignedShardedSIRSimulator",

@@ -53,3 +53,25 @@ def make_mesh(n_devices: int | None = None,
                 f"requested {n_devices} devices, have {len(devs)}")
         devs = devs[:n_devices]
     return Mesh(np.asarray(devs), (PEER_AXIS,))
+
+
+def make_survivor_mesh(n_survivors: int, devs_per_proc: int,
+                       devices: list | None = None) -> Mesh:
+    """The shrink-to-survivors mesh (runtime/supervisor.py): a 1-D
+    mesh over the surviving process set's devices.
+
+    Deterministic in ``(n_survivors, devs_per_proc)`` alone — the
+    supervised worker rebuilds exactly this mesh on every recovery
+    attempt, so the shrunk layout is a pure function of the failure
+    history and the resumed trajectory is the one the elastic
+    checkpoint parity contract covers (docs/ROBUSTNESS.md migration
+    matrix).  Works for both supervised spmd modes: under
+    ``jax.distributed`` the surviving processes' devices ARE the
+    device list; in single-process (chief) rehearsal mode the chief
+    was launched owning ``n_survivors * devs_per_proc`` virtual
+    devices."""
+    if n_survivors < 1 or devs_per_proc < 1:
+        raise ValueError(
+            f"survivor mesh needs >= 1 process and >= 1 device/process "
+            f"(got {n_survivors} x {devs_per_proc})")
+    return make_mesh(n_survivors * devs_per_proc, devices=devices)

@@ -1,0 +1,44 @@
+"""Run-time supervision of multi-process jobs.
+
+The reference protocol's core promise is surviving peer death — ping
+liveness, strike counting, dead-peer eviction (peer.cpp:330-358).  This
+package applies the same promise to the *hosts running the simulation*:
+a supervisor process launches the worker processes of a distributed
+job, watches round-stamped heartbeat files against a per-round deadline
+derived from the traffic model, and treats a hung or dead worker as a
+scheduling event — kill the torn job, shrink the mesh to the surviving
+process set, resume from the last elastic checkpoint (bitwise, by the
+PR-3 cross-layout contract) — instead of a failed run.
+
+Modules:
+  supervisor — health plane, failure classification, deterministic
+               shrink-to-survivors recovery, MTTR accounting
+  worker     — the supervised worker entry point
+               (``python -m p2p_gossipprotocol_tpu.runtime.worker``)
+"""
+
+from p2p_gossipprotocol_tpu.runtime.supervisor import (  # noqa: F401
+    JobPlan,
+    RecoveryEvent,
+    SupervisedResult,
+    Supervisor,
+    WorkerFailure,
+    chunk_deadline_s,
+    classify_exit,
+    read_heartbeat,
+    shrink,
+    write_heartbeat,
+)
+
+__all__ = [
+    "JobPlan",
+    "RecoveryEvent",
+    "SupervisedResult",
+    "Supervisor",
+    "WorkerFailure",
+    "chunk_deadline_s",
+    "classify_exit",
+    "read_heartbeat",
+    "shrink",
+    "write_heartbeat",
+]

@@ -1,0 +1,717 @@
+"""Supervision plane for multi-process runs: health, shrink, resume.
+
+The multi-host tier used to treat host loss as a failed run: if one
+process of a ``jax.distributed`` job hung or died, the whole job wedged
+until a coarse outer timeout and nothing restarted it.  This module is
+the missing liveness layer, the process-level mirror of the gossip
+protocol's own ping/evict machinery:
+
+* **Heartbeats** — each worker writes an atomic, round-stamped JSON
+  heartbeat file after every checkpoint chunk (``hb_<rank>.json`` under
+  the job's run dir).  The heartbeat carries the worker's phase
+  (init/hold/run/done), its current round, and its simulator's analytic
+  per-round HBM traffic (``AlignedSimulator.traffic_model()["total"]``)
+  — the number the supervisor prices into a deadline.
+* **Deadlines** — a worker that misses its deadline is HUNG (the
+  SIGSTOP / wedged-collective case), distinct from one whose process
+  exited (DEAD).  Per-chunk deadline = ``chunk_rounds × traffic_bytes /
+  min_bytes_per_s × slack``, floored — derived from the traffic model
+  so big scenarios get proportionally long leashes, not one magic
+  constant (:func:`chunk_deadline_s`).
+* **Exit-code classification** — reuses the repo's exit-75 contract
+  (utils.checkpoint.EX_RESUMABLE): 75 = the worker salvaged a
+  checkpoint and yielded (relaunch, same layout, never charged);
+  0 = done; 3 = environment impossibility (the multihost rehearsal's
+  skip code); anything else / a signal = a real worker failure.
+* **Deterministic shrink-to-survivors** — on failure the supervisor
+  kills the torn job (a dead collective poisons every participant),
+  drops the failed rank (:func:`shrink` — a pure function, so recovery
+  layout is reproducible from the failure history alone), rebuilds the
+  mesh over the surviving process set (``parallel.mesh
+  .make_survivor_mesh`` on the worker side), and resumes from the last
+  intact elastic checkpoint (``utils.checkpoint.latest_intact``) — which
+  the PR-3 contract proves continues **bitwise-identically** to a run
+  that started on the survivor layout.
+* **MTTR** — every recovery records detect→resumed seconds (failure
+  detected to first post-resume progress heartbeat), the headline
+  number of the chaos harness (benchmarks/chaos_rehearsal.py).
+
+The supervisor process itself never initializes jax — it must stay
+schedulable and killable while workers wedge in C (the tunneled-TPU
+lesson behind engines.probe_backend).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+
+from p2p_gossipprotocol_tpu.utils.checkpoint import (EX_RESUMABLE,
+                                                     CheckpointError,
+                                                     latest_intact)
+
+#: worker exit code for "this environment cannot run the job at all"
+#: (e.g. multi-process CPU collectives on jax < 0.5) — the multihost
+#: rehearsal's established skip convention.  Not a worker failure: the
+#: supervisor either flips the job to its single-process-spmd fallback
+#: or surfaces the skip, it never shrinks on it.
+EX_ENV_SKIP = 3
+
+#: worker exit code for "the coordinator port was stolen between probe
+#: and bind" (EADDRINUSE) — the supervisor relaunches the attempt on a
+#: fresh port instead of evicting the rank (a bind race is nobody's
+#: failure; the multihost rehearsal driver applies the same rule).
+EX_REBIND = 4
+
+#: the marker jax < 0.5 prints when asked for multi-process collectives
+#: on the CPU backend (matched without the apostrophe — tracebacks can
+#: arrive escaped inside a repr).  Same constant the rehearsal and
+#: tests/test_multihost.py match.
+CPU_MULTIPROCESS_ERR = "Multiprocess computations aren"
+
+HB_PHASES = ("launch", "init", "hold", "run", "done")
+
+
+# ----------------------------------------------------------------------
+# Heartbeat protocol (worker side writes, supervisor side reads).
+
+
+def heartbeat_path(run_dir: str, rank: int) -> str:
+    return os.path.join(run_dir, f"hb_{rank}.json")
+
+
+def write_heartbeat(path: str, *, rank: int, phase: str, round: int = 0,
+                    rounds_total: int = 0,
+                    traffic_bytes_round: float | None = None,
+                    chunk_rounds: int = 0, extra: dict | None = None
+                    ) -> None:
+    """Atomically publish a worker's liveness + progress stamp.  The
+    supervisor keys staleness on the file's MTIME (same machine, no
+    clock-skew question), so the write must be tmp+rename — a reader
+    must never see a torn heartbeat."""
+    if phase not in HB_PHASES:
+        raise ValueError(f"unknown heartbeat phase {phase!r}")
+    hb = {"rank": rank, "pid": os.getpid(), "phase": phase,
+          "round": int(round), "rounds_total": int(rounds_total),
+          "chunk_rounds": int(chunk_rounds), "ts": time.time()}
+    if traffic_bytes_round is not None:
+        hb["traffic_bytes_round"] = float(traffic_bytes_round)
+    if extra:
+        hb.update(extra)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fp:
+        fp.write(json.dumps(hb))
+        fp.flush()
+        os.fsync(fp.fileno())
+    os.replace(tmp, path)
+
+
+def read_heartbeat(path: str) -> dict | None:
+    """The heartbeat dict plus its file ``mtime``, or None when absent
+    or torn mid-replace (the next poll sees the committed one)."""
+    try:
+        with open(path) as fp:
+            hb = json.load(fp)
+        hb["mtime"] = os.path.getmtime(path)
+        return hb
+    except (OSError, ValueError):
+        return None
+
+
+# ----------------------------------------------------------------------
+# Deadlines and classification.
+
+
+def chunk_deadline_s(traffic_bytes_round: float | None,
+                     chunk_rounds: int, *,
+                     min_bytes_per_s: float = 50e6,
+                     slack: float = 8.0,
+                     floor_s: float = 10.0) -> float:
+    """Seconds a worker gets between heartbeats before it is HUNG.
+
+    Priced from the worker's own analytic traffic model: a chunk that
+    moves B bytes/round for k rounds must land within ``k*B/bw * slack``
+    where ``min_bytes_per_s`` is a deliberately pessimistic DCN-class
+    floor (50 MB/s — an order below any real link, so a healthy run
+    never grazes the deadline) and ``slack`` absorbs stragglers and
+    host jitter.  ``floor_s`` keeps tiny scenarios from flapping.
+    Workers that cannot price themselves (no traffic model — the edges
+    engine, holders) get the floor."""
+    if traffic_bytes_round is None or traffic_bytes_round <= 0 \
+            or chunk_rounds <= 0:
+        return floor_s
+    est = chunk_rounds * traffic_bytes_round / min_bytes_per_s
+    return max(floor_s, est * slack)
+
+
+def classify_exit(returncode: int) -> str:
+    """Map a worker's exit status to the supervisor's action vocabulary:
+    ``done`` (0), ``resumable`` (75, the salvage contract — relaunch,
+    never charged), ``env_skip`` (3, environment impossibility),
+    ``rebind`` (4, coordinator port race — fresh port, never charged),
+    ``killed`` (died on a signal) or ``crashed`` (anything else)."""
+    if returncode == 0:
+        return "done"
+    if returncode == EX_RESUMABLE:
+        return "resumable"
+    if returncode == EX_ENV_SKIP:
+        return "env_skip"
+    if returncode == EX_REBIND:
+        return "rebind"
+    if returncode < 0:
+        return "killed"
+    return "crashed"
+
+
+def shrink(survivors: tuple[int, ...], failed: int) -> tuple[int, ...]:
+    """The surviving process set after ``failed`` is evicted — a PURE
+    function of (survivors, failed), so the whole recovery layout
+    (mesh size = ``len(survivors) * devs_per_proc``, chief =
+    ``min(survivors)``) is reproducible from the failure history alone.
+    Determinism here is what makes the chaos harness's bitwise-parity
+    assertion meaningful."""
+    if failed not in survivors:
+        raise ValueError(f"rank {failed} is not in {survivors}")
+    return tuple(r for r in survivors if r != failed)
+
+
+# ----------------------------------------------------------------------
+# Job description and outcome records.
+
+
+@dataclass
+class LaunchCtx:
+    """Everything a worker launch depends on — handed to the plan's
+    ``argv``/``env`` builders for each (attempt, rank) pair."""
+
+    rank: int
+    survivors: tuple[int, ...]
+    attempt: int
+    resume: bool
+    port: int
+    spmd: str
+    run_dir: str
+
+
+@dataclass
+class JobPlan:
+    """A supervised job: which ranks exist and how to launch one.
+
+    ``argv(ctx)``/``env(ctx)`` build each worker's command line and
+    environment (the supervisor owns per-attempt facts — survivor set,
+    coordinator port, resume flag — the builders own everything else).
+    ``chief_only=True`` means only the chief rank computes (the CPU
+    rehearsal's single-process-spmd mode): the job succeeds when the
+    chief exits 0, and the supervisor then retires the holders with
+    SIGTERM instead of expecting them to finish."""
+
+    ranks: tuple[int, ...]
+    run_dir: str
+    argv: object                       # Callable[[LaunchCtx], list[str]]
+    env: object | None = None          # Callable[[LaunchCtx], dict]
+    checkpoint_dir: str | None = None
+    spmd: str = "auto"                 # auto | distributed | chief
+    chief_only: bool = False           # set True when spmd == "chief"
+    grace_s: float = 180.0             # launch → first run heartbeat
+    deadline_s: float = 0.0            # 0 = derive via chunk_deadline_s
+    min_bytes_per_s: float = 50e6
+    slack: float = 8.0
+    floor_s: float = 10.0
+    poll_s: float = 0.2
+    min_workers: int = 1
+    max_recoveries: int = 8
+    max_resumes: int = 16              # exit-75 relaunch budget
+    job_timeout_s: float = 0.0         # 0 = no overall budget
+
+
+@dataclass
+class WorkerFailure:
+    rank: int
+    kind: str                           # "dead" | "hung"
+    detail: str
+    detected_at: float                  # time.monotonic()
+
+
+@dataclass
+class RecoveryEvent:
+    """One shrink-to-survivors recovery, with its MTTR clock."""
+
+    failure: WorkerFailure
+    survivors: tuple[int, ...]
+    resumed_round: int
+    attempt: int
+    mttr_s: float | None = None         # detect → first progress
+
+    def as_dict(self) -> dict:
+        return {"failed_rank": self.failure.rank,
+                "kind": self.failure.kind,
+                "detail": self.failure.detail[-500:],
+                "survivors": list(self.survivors),
+                "resumed_round": self.resumed_round,
+                "attempt": self.attempt,
+                "mttr_s": (round(self.mttr_s, 3)
+                           if self.mttr_s is not None else None)}
+
+
+@dataclass
+class SupervisedResult:
+    ok: bool
+    skipped: bool = False
+    reason: str = ""
+    attempts: int = 0
+    resumes: int = 0                    # exit-75 relaunches
+    spmd: str = ""                      # mode the final attempt ran
+    survivors: tuple[int, ...] = ()
+    recoveries: list = field(default_factory=list)
+    result: dict | None = None          # chief's result.json payload
+    wall_s: float = 0.0
+
+    def summary(self) -> dict:
+        return {"ok": self.ok, "skipped": self.skipped,
+                "reason": self.reason, "attempts": self.attempts,
+                "resumes": self.resumes, "spmd": self.spmd,
+                "survivors": list(self.survivors),
+                "recoveries": [r.as_dict() for r in self.recoveries],
+                "mttr_s": [r.as_dict()["mttr_s"]
+                           for r in self.recoveries],
+                "wall_s": round(self.wall_s, 3),
+                "result": self.result}
+
+
+# ----------------------------------------------------------------------
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class Supervisor:
+    """Launch, watch, and self-heal one multi-process job (see module
+    docstring for the protocol).  ``run()`` blocks until the job
+    completes, becomes unrecoverable, or exhausts its budgets."""
+
+    def __init__(self, plan: JobPlan, log=None):
+        self.plan = plan
+        self.log = log or (lambda msg: print(msg, file=sys.stderr))
+        self._procs: dict[int, subprocess.Popen] = {}
+        self._err_paths: dict[int, str] = {}
+
+    # -- process plumbing ---------------------------------------------
+    def _spawn(self, ctx: LaunchCtx) -> subprocess.Popen:
+        argv = self.plan.argv(ctx)
+        env = self.plan.env(ctx) if self.plan.env else dict(os.environ)
+        err_path = os.path.join(self.plan.run_dir,
+                                f"worker_{ctx.rank}.err")
+        self._err_paths[ctx.rank] = err_path
+        # own session per worker: reaping kills the worker's whole
+        # process group, so nothing it forked outlives the job
+        return subprocess.Popen(
+            argv, env=env, start_new_session=True,
+            stdout=open(os.path.join(self.plan.run_dir,
+                                     f"worker_{ctx.rank}.out"), "ab"),
+            stderr=open(err_path, "ab"))
+
+    @staticmethod
+    def _kill(proc: subprocess.Popen, sig: int) -> None:
+        try:
+            os.killpg(proc.pid, sig)
+        except (ProcessLookupError, PermissionError):
+            try:
+                proc.send_signal(sig)
+            except (ProcessLookupError, OSError):
+                pass
+
+    def _reap_job(self, grace_s: float = 5.0) -> None:
+        """Tear the whole job down — a failed participant poisons every
+        collective, so survivors of the OLD job must die before the
+        shrunk job launches (and no orphan may outlive the
+        supervisor).  SIGCONT first: a SIGSTOPped worker must not
+        sleep through its own termination; SIGKILL after grace."""
+        live = [p for p in self._procs.values() if p.poll() is None]
+        for p in live:
+            self._kill(p, signal.SIGCONT)
+            self._kill(p, signal.SIGTERM)
+        deadline = time.monotonic() + grace_s
+        for p in live:
+            while p.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if p.poll() is None:
+                self._kill(p, signal.SIGKILL)
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass
+        self._procs.clear()
+
+    def _stderr_tail(self, rank: int, n: int = 2000) -> str:
+        try:
+            with open(self._err_paths[rank], "rb") as fp:
+                data = fp.read()[-n:]
+            text = data.decode("utf-8", "replace")
+            return text.split("\n", 1)[-1] if len(data) == n else text
+        except (KeyError, OSError):
+            return ""
+
+    def _any_stderr_has(self, marker: str) -> bool:
+        return any(marker in self._stderr_tail(r, 65536)
+                   for r in self._err_paths)
+
+    # -- resume discovery ---------------------------------------------
+    def _resume_round(self) -> tuple[bool, int]:
+        """(resume?, round) from the last intact checkpoint generation
+        — the supervisor's view of what the relaunched job will
+        continue from (utils.checkpoint.latest_intact, the same
+        discovery path the worker's --resume uses)."""
+        d = self.plan.checkpoint_dir
+        if not d or not os.path.exists(os.path.join(d, "manifest.json")):
+            return False, 0
+        try:
+            gen = latest_intact(d, verify=False)
+            return True, gen.round
+        except CheckpointError:
+            # manifest exists but nothing intact is visible: let the
+            # worker's full-verify restore (with its corruption
+            # fallback) have the final word
+            return True, 0
+
+    # -- heartbeat judgement ------------------------------------------
+    def _deadline_for(self, hb: dict | None, attempt_t0: float) -> float:
+        """Absolute MONOTONIC-clock deadline for the next sign of life
+        from this worker."""
+        plan = self.plan
+        if hb is None or hb.get("phase") in ("launch", "init"):
+            # still initializing (compile, distributed rendezvous):
+            # the grace budget runs from attempt start / last stamp
+            base = hb["_mono"] if hb else attempt_t0
+            return base + plan.grace_s
+        if plan.deadline_s > 0:
+            return hb["_mono"] + plan.deadline_s
+        # hold-phase heartbeats refresh sub-second and carry no traffic
+        # model, so they fall through to the floor — which is exactly
+        # the leash a host that only needs to prove liveness deserves
+        return hb["_mono"] + chunk_deadline_s(
+            hb.get("traffic_bytes_round"),
+            int(hb.get("chunk_rounds") or 0),
+            min_bytes_per_s=plan.min_bytes_per_s, slack=plan.slack,
+            floor_s=plan.floor_s)
+
+    # -- the main loop -------------------------------------------------
+    def run(self) -> SupervisedResult:
+        plan = self.plan
+        os.makedirs(plan.run_dir, exist_ok=True)
+        t_start = time.monotonic()
+        survivors = tuple(plan.ranks)
+        spmd = plan.spmd
+        attempt = 0
+        resumes = 0
+        recoveries: list[RecoveryEvent] = []
+        pending: RecoveryEvent | None = None
+        result = SupervisedResult(ok=False)
+
+        def finish(ok: bool, reason: str = "", *, skipped=False):
+            self._reap_job()
+            result.ok = ok
+            result.skipped = skipped
+            result.reason = reason
+            result.attempts = attempt
+            result.resumes = resumes
+            result.spmd = spmd
+            result.survivors = survivors
+            result.recoveries = recoveries
+            result.wall_s = time.monotonic() - t_start
+            res_path = os.path.join(plan.run_dir, "result.json")
+            if ok and os.path.exists(res_path):
+                try:
+                    with open(res_path) as fp:
+                        result.result = json.load(fp)
+                except (OSError, ValueError):
+                    pass
+            return result
+
+        try:
+            while True:
+                attempt += 1
+                resume, resumed_round = self._resume_round()
+                port = _free_port()
+                # stale heartbeats from the previous attempt must not
+                # read as progress
+                for r in plan.ranks:
+                    try:
+                        os.remove(heartbeat_path(plan.run_dir, r))
+                    except OSError:
+                        pass
+                mode = "chief" if spmd == "chief" else "distributed"
+                self.log(f"[supervise] attempt {attempt}: survivors="
+                         f"{list(survivors)} spmd={mode} resume="
+                         f"{resume} (round {resumed_round}) port={port}")
+                self._err_paths.clear()
+                for rank in survivors:
+                    ctx = LaunchCtx(rank=rank, survivors=survivors,
+                                    attempt=attempt, resume=resume,
+                                    port=port, spmd=mode,
+                                    run_dir=plan.run_dir)
+                    self._procs[rank] = self._spawn(ctx)
+                attempt_t0 = time.monotonic()
+                if pending is not None:
+                    pending.resumed_round = resumed_round
+                    pending.attempt = attempt
+
+                verdict = self._watch_attempt(
+                    survivors, mode, attempt_t0, pending,
+                    t_start=t_start)
+                if pending is not None and pending.mttr_s is not None:
+                    pending = None
+
+                kind, detail, rank = verdict
+                if kind == "done":
+                    return finish(True)
+                if kind == "timeout":
+                    return finish(False, detail)
+                if kind in ("resumable", "rebind"):
+                    self._reap_job()
+                    resumes += 1
+                    if resumes > plan.max_resumes:
+                        return finish(
+                            False, f"worker yielded {kind} "
+                            f"{resumes} times — exceeding "
+                            f"max_resumes={plan.max_resumes}")
+                    self.log(f"[supervise] rank {rank} "
+                             + ("yielded with a salvage checkpoint "
+                                "(75) — relaunching, same layout, not "
+                                "charged" if kind == "resumable" else
+                                "lost the coordinator-port bind race "
+                                "(EADDRINUSE) — relaunching on a "
+                                "fresh port, not charged"))
+                    continue
+                if kind == "env_skip":
+                    self._reap_job()
+                    if mode == "distributed" and spmd == "auto":
+                        spmd = "chief"
+                        plan.chief_only = True
+                        self.log("[supervise] distributed backend "
+                                 "impossible here — falling back to "
+                                 "single-process-spmd (chief) mode")
+                        continue
+                    return finish(False, detail, skipped=True)
+
+                # real failure: classify is done — recover
+                failure = WorkerFailure(rank=rank, kind=kind,
+                                        detail=detail,
+                                        detected_at=time.monotonic())
+                self.log(f"[supervise] rank {rank} {kind}: "
+                         f"{detail.splitlines()[-1][:200] if detail else ''}")
+                self._reap_job()
+                try:
+                    survivors = shrink(survivors, rank)
+                except ValueError:
+                    return finish(False,
+                                  f"untracked rank {rank} failed")
+                if len(survivors) < plan.min_workers:
+                    return finish(
+                        False, f"only {len(survivors)} worker(s) left "
+                        f"< min_workers={plan.min_workers} — "
+                        "unrecoverable")
+                if len(recoveries) >= plan.max_recoveries:
+                    return finish(
+                        False, f"{len(recoveries)} recoveries already "
+                        f"spent (max_recoveries={plan.max_recoveries})")
+                pending = RecoveryEvent(failure=failure,
+                                        survivors=survivors,
+                                        resumed_round=0,
+                                        attempt=attempt + 1)
+                recoveries.append(pending)
+        finally:
+            # orphan-proof: no worker outlives the supervisor, however
+            # run() exits (return, exception, KeyboardInterrupt)
+            self._reap_job()
+
+    # -- one attempt's watch loop --------------------------------------
+    def _watch_attempt(self, survivors, mode, attempt_t0,
+                       pending: RecoveryEvent | None, *, t_start):
+        """Watch until the attempt resolves.  Returns ``(kind, detail,
+        rank)`` where kind ∈ done | resumable | env_skip | dead | hung
+        | timeout."""
+        plan = self.plan
+        chief = min(survivors)
+        done_ranks: set[int] = set()
+        while True:
+            now = time.monotonic()
+            if plan.job_timeout_s > 0 \
+                    and now - t_start > plan.job_timeout_s:
+                return ("timeout",
+                        f"job exceeded {plan.job_timeout_s:g}s "
+                        "budget — reaping all workers", -1)
+
+            # MTTR: close the pending recovery at the first sign of
+            # post-resume progress
+            if pending is not None and pending.mttr_s is None:
+                hb = read_heartbeat(heartbeat_path(plan.run_dir, chief))
+                if hb and (hb["phase"] == "done"
+                           or (hb["phase"] == "run"
+                               and hb["round"] > pending.resumed_round)):
+                    pending.mttr_s = now - pending.failure.detected_at
+                    self.log(f"[supervise] recovered: round "
+                             f"{hb['round']} on {len(survivors)} "
+                             f"worker(s), MTTR {pending.mttr_s:.2f}s")
+
+            for rank in survivors:
+                if rank in done_ranks:
+                    continue
+                p = self._procs.get(rank)
+                if p is None:
+                    continue
+                rc = p.poll()
+                if rc is not None:
+                    verdict = classify_exit(rc)
+                    if verdict == "done":
+                        done_ranks.add(rank)
+                        if plan.chief_only and rank == chief:
+                            if pending is not None \
+                                    and pending.mttr_s is None:
+                                pending.mttr_s = (time.monotonic()
+                                                  - pending.failure
+                                                  .detected_at)
+                            return ("done", "", rank)
+                        if done_ranks >= set(survivors):
+                            return ("done", "", rank)
+                        continue
+                    if verdict in ("resumable", "rebind"):
+                        return (verdict, self._stderr_tail(rank), rank)
+                    tail = self._stderr_tail(rank)
+                    if verdict == "env_skip" \
+                            or (mode == "distributed"
+                                and CPU_MULTIPROCESS_ERR in tail):
+                        return ("env_skip", tail, rank)
+                    return ("dead",
+                            f"exit rc={rc} ({verdict}): {tail}", rank)
+                # alive: judge the heartbeat
+                hb = read_heartbeat(heartbeat_path(plan.run_dir, rank))
+                if hb is not None:
+                    # staleness clock = file mtime on the shared
+                    # monotonic-ish local disk; map to monotonic time
+                    hb["_mono"] = now - max(0.0, time.time()
+                                            - hb["mtime"])
+                if now > self._deadline_for(hb, attempt_t0):
+                    # hung (wedged collective, SIGSTOP, dead tunnel):
+                    # SIGKILL — a stopped process ignores everything
+                    # else — and let the exit classification see it
+                    self._kill(self._procs[rank], signal.SIGKILL)
+                    try:
+                        self._procs[rank].wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        pass
+                    stamp = (f"last heartbeat phase="
+                             f"{hb['phase']} round={hb['round']}"
+                             if hb else "no heartbeat ever written")
+                    return ("hung",
+                            f"missed its deadline ({stamp})", rank)
+            time.sleep(plan.poll_s)
+
+
+# ----------------------------------------------------------------------
+# Config-driven entry (the CLI's --supervise / supervise_* keys).
+
+
+def plan_from_config(cfg, *, config_path: str, rounds: int,
+                     run_dir: str, n_peers: int | None = None,
+                     checkpoint_dir: str | None = None,
+                     checkpoint_every: int = 0,
+                     extra_args: tuple[str, ...] = ()) -> JobPlan:
+    """Build the JobPlan for supervising ``config_path``'s scenario:
+    ``supervise_workers`` processes × ``supervise_devs_per_proc``
+    devices, workers entered through
+    ``python -m p2p_gossipprotocol_tpu.runtime.worker``."""
+    import p2p_gossipprotocol_tpu
+
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(p2p_gossipprotocol_tpu.__file__)))
+    workers = max(1, cfg.supervise_workers)
+    devs = max(1, cfg.supervise_devs_per_proc)
+    ckpt = checkpoint_dir or cfg.checkpoint_dir or None
+
+    def argv(ctx: LaunchCtx) -> list[str]:
+        cmd = [sys.executable, "-m",
+               "p2p_gossipprotocol_tpu.runtime.worker", config_path,
+               "--rank", str(ctx.rank),
+               "--survivors", ",".join(map(str, ctx.survivors)),
+               "--total-ranks", str(workers),
+               "--devs-per-proc", str(devs),
+               "--rounds", str(rounds),
+               "--run-dir", ctx.run_dir,
+               "--spmd", ctx.spmd,
+               "--port", str(ctx.port)]
+        if n_peers:
+            cmd += ["--n-peers", str(n_peers)]
+        if ckpt:
+            cmd += ["--checkpoint-dir", ckpt]
+        if checkpoint_every:
+            cmd += ["--checkpoint-every", str(checkpoint_every)]
+        if ctx.resume:
+            cmd += ["--resume"]
+        cmd += list(extra_args)
+        return cmd
+
+    def env(ctx: LaunchCtx) -> dict:
+        e = dict(os.environ)
+        e["PYTHONPATH"] = pkg_root + os.pathsep + e.get("PYTHONPATH", "")
+        # the supervisor vetted the backend question; workers must not
+        # each pay (or hang in) the probe
+        e["GOSSIP_NO_BACKEND_PROBE"] = "1"
+        if ctx.spmd == "chief":
+            # single-process spmd: the chief owns EVERY survivor's
+            # devices as virtual CPU devices; holders get one
+            e["JAX_PLATFORMS"] = "cpu"
+            n_dev = (len(ctx.survivors) * devs
+                     if ctx.rank == min(ctx.survivors) else 1)
+            e["XLA_FLAGS"] = (
+                "--xla_force_host_platform_device_count=" + str(n_dev))
+        else:
+            flags = e.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags \
+                    and e.get("JAX_PLATFORMS", "") == "cpu":
+                e["XLA_FLAGS"] = (flags + " --xla_force_host_platform"
+                                  "_device_count=" + str(devs)).strip()
+        return e
+
+    return JobPlan(
+        ranks=tuple(range(workers)), run_dir=run_dir, argv=argv,
+        env=env, checkpoint_dir=ckpt,
+        spmd=cfg.supervise_spmd,
+        chief_only=(cfg.supervise_spmd == "chief"),
+        grace_s=cfg.supervise_grace_s,
+        deadline_s=cfg.supervise_deadline_s,
+        min_workers=max(1, cfg.supervise_min_workers),
+        max_recoveries=(cfg.supervise_max_failures
+                        if cfg.supervise_max_failures > 0
+                        else max(1, workers - 1)))
+
+
+def supervise_from_config(cfg, *, config_path: str, rounds: int,
+                          n_peers: int | None = None,
+                          checkpoint_dir: str | None = None,
+                          checkpoint_every: int = 0,
+                          quiet: bool = False) -> SupervisedResult:
+    """The CLI's ``--supervise`` engine: build the plan, run the
+    supervisor, return the outcome (the CLI prints ``summary()``)."""
+    import tempfile
+
+    ckpt = checkpoint_dir or cfg.checkpoint_dir
+    if ckpt:
+        run_dir = os.path.join(ckpt, "supervise")
+    else:
+        run_dir = tempfile.mkdtemp(prefix="gossip_supervise_")
+    plan = plan_from_config(cfg, config_path=config_path, rounds=rounds,
+                            run_dir=run_dir, n_peers=n_peers,
+                            checkpoint_dir=checkpoint_dir,
+                            checkpoint_every=checkpoint_every)
+    log = (lambda msg: None) if quiet else None
+    return Supervisor(plan, log=log).run()

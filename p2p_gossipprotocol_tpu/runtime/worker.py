@@ -1,0 +1,284 @@
+"""Supervised worker entry: ``python -m p2p_gossipprotocol_tpu.runtime
+.worker <config_file> --rank R --survivors 0,1 ...``.
+
+One rank of a supervised multi-process job (runtime/supervisor.py).
+The worker's obligations under the health plane:
+
+* write an ``init`` heartbeat BEFORE touching jax (backend init is the
+  canonical place to hang — the stamp proves the process itself came
+  up), then a ``run`` heartbeat after every checkpoint chunk carrying
+  its round and its simulator's analytic per-round traffic
+  (``traffic_model()["total"]``) — the number the supervisor prices
+  into this worker's deadline;
+* honor the exit-code contract: 0 done, 75 salvage-and-yield
+  (SIGTERM/SIGINT under checkpointing — the CLI's preemption contract,
+  reused verbatim), :data:`supervisor.EX_ENV_SKIP` when the
+  environment cannot run the requested spmd mode at all, and
+  :data:`supervisor.EX_REBIND` when the coordinator port was stolen
+  (the supervisor relaunches on a fresh port instead of evicting);
+* build the SAME topology on every attempt: overlay statics are pinned
+  to the ORIGINAL layout (``total_ranks × devs_per_proc`` shards),
+  never the survivor count — the writer's statics win on resume
+  (utils/checkpoint.py), so the uninterrupted-run reference trajectory
+  is well defined across shrinks.
+
+Two spmd modes, chosen by the supervisor:
+
+* ``distributed`` — the real multi-host shape: the survivor set forms
+  one ``jax.distributed`` job (process_id = index into the survivor
+  tuple — deterministic), mesh over all global devices.
+* ``chief`` — the single-process-spmd rehearsal shape for backends
+  where multi-process collectives don't exist (CPU, jax < 0.5): the
+  chief (lowest surviving rank) owns every survivor's devices as
+  virtual devices and runs the whole sharded program;
+  non-chief ranks HOLD — they heartbeat and model device-owning hosts,
+  and their death still tears the job exactly like a real host loss.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+from p2p_gossipprotocol_tpu.runtime.supervisor import (EX_ENV_SKIP,
+                                                       CPU_MULTIPROCESS_ERR,
+                                                       EX_REBIND,
+                                                       heartbeat_path,
+                                                       write_heartbeat)
+
+_ADDRINUSE_MARKERS = ("address already in use", "EADDRINUSE")
+
+
+def _parse(argv):
+    ap = argparse.ArgumentParser(prog="runtime.worker")
+    ap.add_argument("config_file")
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--survivors", required=True,
+                    help="comma-separated surviving ranks (ordered)")
+    ap.add_argument("--total-ranks", type=int, required=True,
+                    help="the job's ORIGINAL rank count — pins the "
+                         "overlay statics across shrinks")
+    ap.add_argument("--devs-per-proc", type=int, default=1)
+    ap.add_argument("--rounds", type=int, required=True)
+    ap.add_argument("--run-dir", required=True)
+    ap.add_argument("--spmd", choices=["distributed", "chief"],
+                    default="chief")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--n-peers", type=int, default=None)
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="overrides the config's checkpoint_dir (the "
+                         "supervisor forwards the CLI flag)")
+    ap.add_argument("--checkpoint-every", type=int, default=0)
+    ap.add_argument("--hold-interval", type=float, default=0.5)
+    return ap.parse_args(argv)
+
+
+def _hold(args, hb_path: str) -> int:
+    """Non-chief rank in chief mode: model a device-owning host.  No
+    jax import at all — the process exists to be alive (and to be
+    killable by the chaos harness)."""
+    stop = {"flag": False}
+
+    def handler(signum, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, handler)
+    signal.signal(signal.SIGINT, handler)
+    while not stop["flag"]:
+        write_heartbeat(hb_path, rank=args.rank, phase="hold",
+                        rounds_total=args.rounds)
+        time.sleep(args.hold_interval)
+    return 0
+
+
+def _build_sim(cfg, args, mesh_devices: int):
+    """The supervised scenario on ``mesh_devices`` devices, overlay
+    statics pinned to the ORIGINAL ``total_ranks × devs_per_proc``
+    grid (see module docstring)."""
+    from p2p_gossipprotocol_tpu.aligned import build_aligned
+    from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+    from p2p_gossipprotocol_tpu.parallel import AlignedShardedSimulator
+    from p2p_gossipprotocol_tpu.parallel.mesh import make_survivor_mesh
+
+    n_peers = args.n_peers or cfg.n_peers or 4096
+    n_msgs = cfg.n_messages or cfg.max_message_count
+    total_devices = args.total_ranks * args.devs_per_proc
+    topo = build_aligned(
+        seed=cfg.prng_seed, n=n_peers, n_slots=6, rowblk=1,
+        n_shards=total_devices, roll_groups=cfg.roll_groups or 3)
+    churn = (ChurnConfig(rate=cfg.churn_rate, kill_round=1)
+             if cfg.churn_rate > 0 else None)
+    return AlignedShardedSimulator(
+        topo=topo,
+        mesh=make_survivor_mesh(mesh_devices // args.devs_per_proc,
+                                args.devs_per_proc),
+        n_msgs=n_msgs, mode=cfg.mode, churn=churn,
+        max_strikes=cfg.max_missed_pings,
+        message_stagger=cfg.message_stagger,
+        pull_window=bool(cfg.pull_window),
+        fuse_update=bool(cfg.fuse_update),
+        seed=cfg.prng_seed)
+
+
+def _run_supervised(args, cfg, hb_path: str, *, mesh_devices: int,
+                    is_chief: bool) -> int:
+    """Build, run under the checkpoint runner, heartbeat per chunk —
+    shared by the chief and every distributed rank."""
+    from p2p_gossipprotocol_tpu.engines import config_keys
+    from p2p_gossipprotocol_tpu.utils.checkpoint import (EX_RESUMABLE,
+                                                         CheckpointError,
+                                                         run_chunked,
+                                                         run_with_checkpoints)
+
+    sim = _build_sim(cfg, args, mesh_devices)
+    try:
+        inner = getattr(sim, "_inner", sim)
+        traffic = float(inner.traffic_model(
+            n_shards=mesh_devices)["total"])
+    except Exception:  # noqa: BLE001 — a worker without a model still
+        traffic = None  # heartbeats; the supervisor uses its floor
+
+    ckpt_dir = args.checkpoint_dir or cfg.checkpoint_dir or None
+    every = (args.checkpoint_every or cfg.checkpoint_every
+             or max(1, args.rounds // 8))
+    stop = {"flag": False}
+
+    def handler(signum, frame):
+        print("[worker] signal received — salvage at the next chunk "
+              "boundary, exiting resumable (75)", file=sys.stderr)
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, handler)
+    signal.signal(signal.SIGINT, handler)
+
+    def on_round(done: int) -> None:
+        write_heartbeat(hb_path, rank=args.rank, phase="run",
+                        round=done, rounds_total=args.rounds,
+                        traffic_bytes_round=traffic,
+                        chunk_rounds=every)
+
+    try:
+        if ckpt_dir:
+            res = run_with_checkpoints(
+                sim, args.rounds, every=every,
+                directory=ckpt_dir, resume=args.resume,
+                should_stop=lambda: stop["flag"],
+                config_keys=config_keys(cfg, n_peers=args.n_peers),
+                engine="aligned-supervised", on_chunk=on_round)
+        else:
+            def progress(state, topo, hist, wall, done):
+                on_round(done)
+
+            res, *_ = run_chunked(sim, args.rounds, every=every,
+                                  after_chunk=progress,
+                                  should_stop=lambda: stop["flag"])
+    except CheckpointError as e:
+        print(f"[worker] checkpoint error: {e}", file=sys.stderr)
+        return 1
+    done_rounds = 0 if res is None else len(res.coverage)
+    if done_rounds < args.rounds:
+        # interrupted before completion: 75 iff a salvage checkpoint
+        # actually landed at the last chunk boundary (the CLI contract)
+        if res is not None and ckpt_dir:
+            return EX_RESUMABLE
+        return 1
+
+    if is_chief:
+        line = {
+            "rank": args.rank,
+            "survivors": [int(r) for r in args.survivor_list],
+            "mesh_devices": mesh_devices,
+            "rounds_run": int(len(res.coverage)),
+            "final_coverage": round(float(res.coverage[-1]), 6),
+            "evictions": int(res.evictions.sum()),
+            "live_peers": int(res.live_peers[-1]),
+            "wall_s": round(float(res.wall_s), 3),
+        }
+        tmp = os.path.join(args.run_dir, "result.json.tmp")
+        with open(tmp, "w") as fp:
+            json.dump(line, fp)
+        os.replace(tmp, os.path.join(args.run_dir, "result.json"))
+        print("WORKER_RESULT " + json.dumps(line), flush=True)
+    write_heartbeat(hb_path, rank=args.rank, phase="done",
+                    round=args.rounds, rounds_total=args.rounds)
+    return 0
+
+
+def main(argv=None) -> int:
+    args = _parse(sys.argv[1:] if argv is None else argv)
+    args.survivor_list = tuple(
+        int(r) for r in args.survivors.split(",") if r != "")
+    if args.rank not in args.survivor_list:
+        print(f"[worker] rank {args.rank} not in survivor set "
+              f"{args.survivor_list}", file=sys.stderr)
+        return 1
+    os.makedirs(args.run_dir, exist_ok=True)
+    hb_path = heartbeat_path(args.run_dir, args.rank)
+    write_heartbeat(hb_path, rank=args.rank, phase="init",
+                    rounds_total=args.rounds)
+
+    from p2p_gossipprotocol_tpu.config import ConfigError, NetworkConfig
+
+    try:
+        cfg = NetworkConfig(args.config_file)
+    except ConfigError as e:
+        print(f"[worker] {e}", file=sys.stderr)
+        return 1
+    if cfg.mode == "sir":
+        print("[worker] supervision covers the gossip modes (the SIR "
+              "engines have no sharded checkpoint contract yet)",
+              file=sys.stderr)
+        return 1
+
+    chief = min(args.survivor_list)
+    if args.spmd == "chief":
+        if args.rank != chief:
+            return _hold(args, hb_path)
+        mesh_devices = len(args.survivor_list) * args.devs_per_proc
+        return _run_supervised(args, cfg, hb_path,
+                               mesh_devices=mesh_devices,
+                               is_chief=True)
+
+    # distributed: the survivor set forms one jax.distributed job
+    import jax
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=f"127.0.0.1:{args.port}",
+            num_processes=len(args.survivor_list),
+            process_id=args.survivor_list.index(args.rank))
+    except Exception as e:  # noqa: BLE001 — named exits, never a hang
+        msg = str(e)
+        if any(m.lower() in msg.lower() for m in _ADDRINUSE_MARKERS):
+            print(f"[worker] coordinator port {args.port} already in "
+                  "use — asking the supervisor for a fresh one",
+                  file=sys.stderr)
+            return EX_REBIND
+        print(f"[worker] jax.distributed.initialize failed: {msg}",
+              file=sys.stderr)
+        return 1
+    try:
+        mesh_devices = len(jax.devices())
+        rc = _run_supervised(args, cfg, hb_path,
+                             mesh_devices=mesh_devices,
+                             is_chief=(args.rank == chief))
+    except Exception as e:  # noqa: BLE001
+        if CPU_MULTIPROCESS_ERR in str(e):
+            print(f"[worker] {e}", file=sys.stderr)
+            return EX_ENV_SKIP
+        raise
+    finally:
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # noqa: BLE001 — torn job: exit code wins
+            pass
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

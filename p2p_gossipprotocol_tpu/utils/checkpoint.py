@@ -483,6 +483,106 @@ def _load_generation(directory: str, entry: dict):
     return canonical, hist, wall, done
 
 
+def read_manifest(path: str, *, schema_max: int = SCHEMA_VERSION,
+                  what: str = "checkpoint") -> dict:
+    """Load + sanity-check a manifest file (solo ``manifest.json`` or
+    the fleet driver's ``sweep_manifest.json`` — same torn-write and
+    schema discipline).  Named errors only: a missing manifest is a
+    :class:`CheckpointError` (refusing to silently start over), an
+    unreadable one a :class:`CorruptCheckpoint`, a newer schema a
+    :class:`CheckpointError` telling the operator to upgrade."""
+    if not os.path.exists(path):
+        raise CheckpointError(
+            f"resume requested but {os.path.dirname(path) or '.'!r} "
+            f"holds no {what} (no {os.path.basename(path)}) — refusing "
+            "to silently start over")
+    try:
+        with open(path) as fp:
+            manifest = json.load(fp)
+    except Exception as e:  # noqa: BLE001
+        raise CorruptCheckpoint(
+            f"{os.path.basename(path)} is unreadable "
+            f"({type(e).__name__}: {e}) — the {what} directory cannot "
+            "be trusted") from e
+    if int(manifest.get("schema", 0)) > schema_max:
+        raise CheckpointError(
+            f"{what} manifest schema {manifest.get('schema')} is newer "
+            f"than this build's {schema_max} — upgrade to resume it")
+    return manifest
+
+
+class Generation:
+    """One verified checkpoint generation, as :func:`latest_intact`
+    returns it.  With ``verify=False`` only the manifest and file
+    presence were checked — ``canonical``/``hist``/``wall`` are None
+    and ``round`` comes from the manifest entry."""
+
+    def __init__(self, manifest, entry, canonical, hist, wall, round_):
+        self.manifest = manifest
+        self.entry = entry
+        self.canonical = canonical
+        self.hist = hist
+        self.wall = wall
+        self.round = round_
+
+
+def latest_intact(directory: str, *, config_keys: dict | None = None,
+                  verify: bool = True) -> Generation:
+    """The newest checkpoint generation in ``directory`` that survives
+    verification — THE discovery path shared by the CLI's resume
+    (:func:`run_with_checkpoints`) and the runtime supervisor
+    (runtime/supervisor.py), which must know whether (and from which
+    round) a torn job can resume before it relaunches workers.
+
+    ``verify=True`` (default) restores + CRC-checks the generation and
+    falls back, loudly, from a corrupt latest generation to the
+    previous intact one.  ``verify=False`` checks the manifest and the
+    generation's files' presence only — the cheap form a monitoring
+    loop can poll without loading arrays (restore re-verifies anyway).
+
+    Raises the module's named errors: :class:`CheckpointError` (no
+    manifest / no generations), :class:`CorruptCheckpoint` (nothing
+    intact, listing every generation's defect), and — when
+    ``config_keys`` is given — :class:`FingerprintMismatch` listing
+    the drifted keys."""
+    import sys
+
+    manifest = read_manifest(os.path.join(directory, "manifest.json"))
+    _fingerprint_check(manifest, config_keys)
+    entries = sorted(manifest.get("checkpoints", []),
+                     key=lambda e: int(e["round"]), reverse=True)
+    if not entries:
+        raise CorruptCheckpoint(
+            "manifest.json lists no checkpoint generations")
+    failures: list[str] = []
+    for entry in entries:
+        done = int(entry["round"])
+        if not verify:
+            state_dir = os.path.join(directory, f"state_{done}")
+            hist_path = os.path.join(directory, f"history_{done}.npz")
+            if not (os.path.isdir(state_dir)
+                    and os.path.exists(hist_path)):
+                failures.append(f"state_{done}/history_{done}.npz "
+                                "missing or torn")
+                continue
+            return Generation(manifest, entry, None, None, None, done)
+        try:
+            canonical, hist, wall, done = _load_generation(directory,
+                                                           entry)
+        except CorruptCheckpoint as e:
+            failures.append(str(e))
+            continue
+        if failures:
+            print("[checkpoint] latest generation corrupt ("
+                  + "; ".join(failures)
+                  + f") — falling back to intact round {done}",
+                  file=sys.stderr)
+        return Generation(manifest, entry, canonical, hist, wall, done)
+    raise CorruptCheckpoint(
+        f"no intact checkpoint generation in {directory!r}: "
+        + "; ".join(failures))
+
+
 def _fingerprint_check(manifest: dict, config_keys: dict | None) -> None:
     if config_keys is None or manifest.get("config_keys") is None:
         return
@@ -525,8 +625,6 @@ def run_with_checkpoints(sim, rounds: int, *, every: int, directory: str,
     complete generations only, and restore falls back from a corrupt
     latest generation to the previous intact one.
     """
-    import sys
-
     import numpy as np
 
     os.makedirs(directory, exist_ok=True)
@@ -539,54 +637,18 @@ def run_with_checkpoints(sim, rounds: int, *, every: int, directory: str,
     done, wall = 0, 0.0
     if resume:
         legacy = os.path.join(directory, "history.npz")
-        if not os.path.exists(manifest_path):
-            if os.path.exists(legacy):
-                state, topo, hist, wall, done = _resume_legacy(
-                    sim, directory, rounds)
-            else:
-                raise CheckpointError(
-                    f"resume requested but {directory!r} holds no "
-                    "checkpoint (no manifest.json) — refusing to "
-                    "silently start over")
+        if not os.path.exists(manifest_path) and os.path.exists(legacy):
+            state, topo, hist, wall, done = _resume_legacy(
+                sim, directory, rounds)
         else:
-            try:
-                with open(manifest_path) as fp:
-                    manifest = json.load(fp)
-            except Exception as e:  # noqa: BLE001
-                raise CorruptCheckpoint(
-                    f"manifest.json is unreadable ({type(e).__name__}: "
-                    f"{e}) — the checkpoint directory cannot be "
-                    "trusted") from e
-            if int(manifest.get("schema", 0)) > SCHEMA_VERSION:
-                raise CheckpointError(
-                    f"checkpoint manifest schema "
-                    f"{manifest.get('schema')} is newer than this "
-                    f"build's {SCHEMA_VERSION} — upgrade to resume it")
-            _fingerprint_check(manifest, config_keys)
-            entries = sorted(manifest.get("checkpoints", []),
-                             key=lambda e: int(e["round"]), reverse=True)
-            if not entries:
-                raise CorruptCheckpoint(
-                    "manifest.json lists no checkpoint generations")
-            canonical = None
-            failures = []
-            for i, entry in enumerate(entries):
-                try:
-                    canonical, hist, wall, done = _load_generation(
-                        directory, entry)
-                except CorruptCheckpoint as e:
-                    failures.append(str(e))
-                    continue
-                if failures:
-                    print("[checkpoint] latest generation corrupt ("
-                          + "; ".join(failures)
-                          + f") — falling back to intact round {done}",
-                          file=sys.stderr)
-                break
-            if canonical is None:
-                raise CorruptCheckpoint(
-                    f"no intact checkpoint generation in {directory!r}: "
-                    + "; ".join(failures))
+            # THE generation-discovery path (latest_intact) — shared
+            # with the runtime supervisor, so the CLI and the
+            # self-healing relaunch can never disagree about which
+            # generation a torn run resumes from.
+            gen = latest_intact(directory, config_keys=config_keys)
+            manifest = gen.manifest
+            canonical, hist, wall, done = (gen.canonical, gen.hist,
+                                           gen.wall, gen.round)
             if done > rounds:
                 raise CheckpointError(
                     f"checkpoint already contains {done} rounds > the "

@@ -55,6 +55,16 @@ class Peer:
             )
         else:
             self.node = None
+            if getattr(cfg, "supervise", 0):
+                # supervision launches and kills WORKER PROCESSES; the
+                # facade is one in-process peer — routing it here would
+                # silently drop the health plane the config asked for
+                raise ValueError(
+                    "supervise=1 (self-healing multi-process runs) is "
+                    "not reachable through the wrapper.Peer facade — "
+                    "use the CLI's --supervise, or "
+                    "p2p_gossipprotocol_tpu.runtime.supervisor "
+                    "directly")
             #: engine ceilings from_config had to apply (aligned engine
             #: only; surfaced, never silent — same contract as the CLI)
             self.clamps: list[str] = []

@@ -82,9 +82,16 @@ def devices8():
 # instead.  Scoped by module name, so any suite touching real sockets
 # (test_socket_*, test_transport, ...) is covered automatically — and
 # the preemption suite (test_preemption drives kill/resume CLI
-# subprocesses, which can wedge the same way) rides the same guard.
+# subprocesses, which can wedge the same way) rides the same guard, as
+# does the supervisor suite (test_supervisor drives stub-worker and
+# chaos subprocesses whose whole point is wedging on cue — this guard
+# keeps a supervision bug from wedging tier-1 itself).  The slow chaos
+# tests run multi-attempt supervised jobs (compile x attempts + a
+# reference run), bounded — but not by the 120 s leash, so the
+# supervisor module gets its own budget.
 
 SOCKET_TEST_TIMEOUT_S = 120
+SUPERVISOR_TEST_TIMEOUT_S = 420
 
 
 @pytest.fixture(autouse=True)
@@ -92,19 +99,22 @@ def _socket_suite_timeout(request):
     import signal
 
     mod = getattr(request.module, "__name__", "")
-    guarded = "socket" in mod or "preemption" in mod
+    guarded = "socket" in mod or "preemption" in mod \
+        or "supervisor" in mod
     if not guarded or not hasattr(signal, "SIGALRM"):
         yield
         return
+    budget = (SUPERVISOR_TEST_TIMEOUT_S if "supervisor" in mod
+              else SOCKET_TEST_TIMEOUT_S)
 
     def _fire(signum, frame):
         raise TimeoutError(
-            f"socket-suite test exceeded {SOCKET_TEST_TIMEOUT_S}s "
+            f"guarded-suite test exceeded {budget}s "
             "(per-test guard; a blocking accept/recv or subprocess "
             "wedged)")
 
     old = signal.signal(signal.SIGALRM, _fire)
-    signal.alarm(SOCKET_TEST_TIMEOUT_S)
+    signal.alarm(budget)
     try:
         yield
     finally:

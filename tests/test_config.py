@@ -274,3 +274,31 @@ def test_examples_scale_config_selects_the_measured_best_layout():
     assert sim.pull_window and sim.topo.roll_groups == 4
     assert sim.message_stagger == 1
     assert sim.liveness_every == 3          # 13 s / 5 s
+
+
+def test_supervise_keys_parse_and_validate(tmp_path):
+    cfg = NetworkConfig(write(
+        tmp_path, "10.0.0.1:9000\nsupervise=1\nsupervise_workers=4\n"
+        "supervise_devs_per_proc=2\nsupervise_spmd=chief\n"
+        "supervise_grace_s=30\nsupervise_deadline_s=5\n"
+        "supervise_min_workers=2\n"))
+    assert cfg.supervise == 1
+    assert cfg.supervise_workers == 4
+    assert cfg.supervise_devs_per_proc == 2
+    assert cfg.supervise_spmd == "chief"
+    assert cfg.supervise_grace_s == 30.0
+    assert cfg.supervise_deadline_s == 5.0
+    assert cfg.supervise_min_workers == 2
+
+
+def test_supervise_bad_values_are_named_errors(tmp_path):
+    with pytest.raises(ConfigError, match="supervise_spmd"):
+        NetworkConfig(write(
+            tmp_path, "10.0.0.1:9000\nsupervise_spmd=quorum\n"))
+    with pytest.raises(ConfigError, match="supervise_min_workers"):
+        NetworkConfig(write(
+            tmp_path, "10.0.0.1:9000\nsupervise=1\n"
+            "supervise_workers=2\nsupervise_min_workers=3\n"))
+    with pytest.raises(ConfigError, match="non-negative"):
+        NetworkConfig(write(
+            tmp_path, "10.0.0.1:9000\nsupervise_grace_s=-1\n"))

@@ -202,3 +202,67 @@ def test_facade_elastic_checkpoint_salvage_and_resume(tmp_path):
     np.testing.assert_array_equal(resumed.coverage, full.coverage)
     np.testing.assert_array_equal(np.asarray(resumed.state.seen_w),
                                   np.asarray(full.state.seen_w))
+
+
+def test_facade_checkpoint_resume_empty_dir_named_error(tmp_path):
+    """checkpoint_resume=1 against a directory with no checkpoint must
+    surface the NAMED refuse-to-start-over error through the facade's
+    join() (the worker thread captures it), not hang or return None
+    silently — the facade twin of the CLI's error path."""
+    import pytest
+
+    from p2p_gossipprotocol_tpu.utils.checkpoint import CheckpointError
+
+    cfg = tmp_path / "net.txt"
+    cfg.write_text("10.0.0.1:8000\nbackend=jax\ngraph=er\nn_peers=256\n"
+                   "avg_degree=6\nmode=push\nrounds=8\nprng_seed=0\n"
+                   f"checkpoint_dir={tmp_path / 'empty_ck'}\n"
+                   "checkpoint_resume=1\n")
+    peer = Peer(str(cfg))
+    peer.start()
+    with pytest.raises(CheckpointError,
+                       match="refusing to silently start over"):
+        peer.join(timeout=120)
+    assert not peer.is_running()
+
+
+def test_facade_checkpoint_fingerprint_drift_named_error(tmp_path):
+    """Resuming a facade checkpoint under a DIFFERENT scenario must
+    raise FingerprintMismatch naming the drifted key — the facade uses
+    the same engines.config_keys identity as the CLI, so the two
+    surfaces cannot accept each other's rejects."""
+    import pytest
+
+    from p2p_gossipprotocol_tpu.utils.checkpoint import \
+        FingerprintMismatch
+
+    ck = tmp_path / "ck"
+    base = ("10.0.0.1:8000\nbackend=jax\ngraph=er\navg_degree=6\n"
+            "mode=push\nrounds=8\nprng_seed=0\n"
+            f"checkpoint_every=4\ncheckpoint_dir={ck}\n")
+    cfg_w = tmp_path / "net_w.txt"
+    cfg_w.write_text(base + "n_peers=256\n")
+    writer = Peer(str(cfg_w))
+    writer.start()
+    assert writer.join(timeout=120) is not None
+
+    cfg_r = tmp_path / "net_r.txt"
+    cfg_r.write_text(base + "n_peers=512\ncheckpoint_resume=1\n")
+    reader = Peer(str(cfg_r))
+    reader.start()
+    with pytest.raises(FingerprintMismatch, match="n_peers"):
+        reader.join(timeout=120)
+
+
+def test_facade_refuses_supervise_with_pointer(tmp_path):
+    """supervise=1 spawns worker processes — the in-process facade must
+    refuse by name (pointing at the CLI's --supervise), never silently
+    drop the health plane the config asked for."""
+    import pytest
+
+    cfg = tmp_path / "net.txt"
+    cfg.write_text("10.0.0.1:8000\nbackend=jax\nengine=aligned\n"
+                   "n_peers=2048\nmode=pushpull\nrounds=8\n"
+                   "supervise=1\n")
+    with pytest.raises(ValueError, match="--supervise"):
+        Peer(str(cfg))
