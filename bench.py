@@ -44,7 +44,11 @@ delta-exchange path, bitwise-identical to dense; the A/B lives in
 benchmarks/measure_round8.py), GOSSIP_BENCH_CHECK_EVERY (1,
 clamped to [1, MAX_ROUNDS]), GOSSIP_BENCH_STEADY_ROUNDS (256 on TPU,
 0 elsewhere), GOSSIP_BENCH_STEADY_TIMEOUT_S (420),
-GOSSIP_BENCH_FAULTS (a faults.FaultPlan spec, e.g. "drop=0.2"; also
+GOSSIP_BENCH_PREFETCH (0; -1/2 = auto/force the round-10
+double-buffered DMA stream — bitwise-identical to the pipelined path;
+the A/B lives in benchmarks/measure_round10.py),
+GOSSIP_BENCH_ROOF_GB_S (800, the v5e HBM roof the roofline_frac
+column divides by), GOSSIP_BENCH_FAULTS (a faults.FaultPlan spec, e.g. "drop=0.2"; also
 reachable as ``bench.py --faults SPEC``) — the run executes under the
 fault plan and the result line carries a ``faults`` column, so
 BENCH_*.json rows can track fault-plane overhead and
@@ -71,6 +75,12 @@ MAX_ROUNDS = 128
 # The real chip registers as the experimental "axon" PJRT platform, not
 # "tpu" (BENCH_r02 tail; aligned.py treats both as the TPU path).
 TPU_PLATFORMS = ("tpu", "axon")
+# HBM roofline denominator for the ``roofline_frac`` column: the ~800
+# GB/s v5e HBM roof the repo's achieved_gb_s notes have always quoted
+# (docs/PERFORMANCE.md).  Override with GOSSIP_BENCH_ROOF_GB_S when
+# benchmarking a different chip; the value used is recorded on the row
+# so roofline_frac stays reproducible from the artifacts alone.
+ROOF_GB_S = 800.0
 
 
 def _fault_plan():
@@ -207,6 +217,27 @@ def _init_backend(max_tries: int | None = None,
                        f"{last_err[0]!r}")
 
 
+def _roofline(bytes_round: int, rounds: int, wall: float) -> dict:
+    """The round-10 headline column: achieved fraction of the chip's
+    HBM roofline — ``achieved_gb_s`` (traffic_model bytes over measured
+    wall) divided by the roof the model's bytes are priced against.
+    The roof used rides the row (``roof_gb_s``), so the fraction is
+    reproducible from the artifacts alone: roofline_frac ==
+    bytes_per_round * rounds / value / (roof_gb_s * 1e9).  Same
+    provenance discipline as achieved_gb_s: computed from THIS run's
+    model and wall, never inherited from a recorded row."""
+    try:
+        roof = float(os.environ.get("GOSSIP_BENCH_ROOF_GB_S",
+                                    str(ROOF_GB_S)))
+    except ValueError:
+        roof = ROOF_GB_S
+    if wall <= 0 or roof <= 0:
+        return {}
+    gbs = bytes_round * rounds / wall / 1e9
+    return {"roof_gb_s": roof,
+            "roofline_frac": round(gbs / roof, 4)}
+
+
 def _check_converged(final_cov: float, rounds: int) -> None:
     """Success = the target was reached, full stop.  (Checking the round
     count alone misreports a boundary-round success — run_to_coverage can
@@ -268,6 +299,11 @@ def _bench_aligned(n, n_msgs, degree, mode):
     # benchmarks/measure_round8.py, and the engine's own AUTO rule
     # (on for the compiled path) governs production runs.
     frontier_mode = _env_int("GOSSIP_BENCH_FRONTIER", 0)
+    # Round-10 double-buffered DMA stream: bench default stays 0 so
+    # headline rows remain comparable across rounds (the frontier
+    # precedent); the engine's own AUTO (-1) governs production runs
+    # and benchmarks/measure_round10.py owns the A/B.
+    prefetch_depth = _env_int("GOSSIP_BENCH_PREFETCH", 0)
     # VMEM row block: AUTO sizes it to the budget (wide blocks at small
     # W — the block-sizing lever against the partial-reuse gap);
     # GOSSIP_BENCH_ROWBLK pins it for A/Bs.
@@ -320,6 +356,7 @@ def _bench_aligned(n, n_msgs, degree, mode):
             message_stagger=stagger,
             fuse_update=fuse_update, pull_window=pw, faults=plan,
             frontier_mode=frontier_mode,
+            prefetch_depth=prefetch_depth,
             seed=0)
 
     try:
@@ -430,6 +467,8 @@ def _bench_aligned(n, n_msgs, degree, mode):
         "bytes_per_round": bytes_round,
         "achieved_gb_s": (round(bytes_round * rounds / wall / 1e9, 1)
                           if wall > 0 else None),
+        **_roofline(bytes_round, rounds, wall),
+        **({"prefetch_depth": prefetch_depth} if prefetch_depth else {}),
         **steady,
         **fleet,
     }

@@ -109,7 +109,7 @@ record_fail() {
   fi
 }
 
-STEP_NAMES="bench mosaic_smoke measure_round4 measure_round5 measure_round6 measure_round7 measure_round8 measure_round9 baselines multihost longrun"
+STEP_NAMES="bench mosaic_smoke measure_round4 measure_round5 measure_round6 measure_round7 measure_round8 measure_round9 measure_round10 baselines multihost longrun"
 # Headline first: a short tunnel window must yield the most important
 # artifact.  bench keeps its file contract (ONE parsed line) and only
 # stamps when the line really came from the chip.  longrun is the
@@ -134,6 +134,7 @@ PY" ;;
     measure_round7) echo "python benchmarks/measure_round7.py" ;;
     measure_round8) echo "python benchmarks/measure_round8.py" ;;
     measure_round9) echo "python benchmarks/measure_round9.py" ;;
+    measure_round10) echo "python benchmarks/measure_round10.py" ;;
     baselines)      echo "python benchmarks/run_baselines.py" ;;
     multihost)
       # the multi-host step is DELEGATED to the runtime supervisor
@@ -165,6 +166,7 @@ step_tmo() {
     measure_round7) echo 3600 ;;
     measure_round8) echo 3600 ;;
     measure_round9) echo 3600 ;;
+    measure_round10) echo 3600 ;;
     baselines) echo 4800 ;;
     multihost) echo 1800 ;;
     longrun) echo 1800 ;;

@@ -67,6 +67,21 @@ MAX_CONFIG_MSGS = 2048
 # calibrated model errs conservative (more modeled bytes, never fewer).
 Y_REUSE_LEAK = 0.43
 
+# Partial-reuse leak on the MANUAL double-buffered stream
+# (gossip_pass(prefetch_depth=2)): zero, by construction rather than
+# calibration — the κ=0.43 above prices Mosaic's pipeline re-issuing
+# part of a copy for a resident block, and the manual stream issues NO
+# descriptor for a resident re-serve at all (the copy-start is gated on
+# an index CHANGE, the same dedup rule stream_plan replays).  Charging
+# 0 here is the CONSERVATIVE direction for everything the model feeds:
+# fewer modeled bytes -> lower achieved_gb_s and roofline_frac, so the
+# prefetch path can only under-report its own win.  The on-chip
+# recalibration microbench (kernel-only rolls 16-vs-4 under prefetch
+# on/off) ships in benchmarks/measure_round10.py for the next TPU
+# window; a measured nonzero leak would land here with its derivation
+# (docs/PERFORMANCE.md "Round 10").
+Y_REUSE_LEAK_PREFETCH = 0.0
+
 # from_config auto-selects the block-perm fused overlay at this message
 # width and above: the on-chip A/B (round5_tpu.jsonl) measured -43%
 # ms/round at W=8 (256 msgs) and a wash at W=1 (16 msgs) — the deleted
@@ -538,6 +553,46 @@ def _skip_plan(y: jax.Array, rowblk: int, t_local: int,
     return skip_tables(idx_raw, act)
 
 
+def _overlap_plans(frontier_l: jax.Array, y_g: jax.Array, rowblk: int,
+                   t_off: jax.Array, ytab_local: jax.Array, skip: bool):
+    """((yidx_A, yact_A), (yidx_B, yact_B)) — the self/remote split of
+    one push pass's grid for the compute-hidden exchange.
+
+    Pass A computes the SELF-shard contribution from the LOCAL send
+    planes (``frontier_l``) — it has no data dependency on the
+    collective, so hardware schedulers overlap the exchange with it —
+    and pass B the REMOTE contribution from the gathered planes,
+    OR-seeded with pass A's accumulator.  The two activity gates are
+    exact complements over the (frontier-)active blocks, so every grid
+    step contributes in exactly one pass and the OR-merged result is
+    bitwise the single-pass one.  With ``skip`` the frontier activity
+    mask composes in (a dead block is gated off in BOTH passes, exactly
+    like the single-pass skip).  Pass A's remap indices convert to the
+    local block frame (its y array holds only this shard's blocks);
+    leading pins that land outside it clamp — their steps are gated."""
+    W_l, Rl, C = frontier_l.shape
+    ty_l = Rl // rowblk
+    ty_g = y_g.shape[1] // rowblk
+    idx_raw = ytab_local.T                         # [T_local, D], global
+    bid = jnp.arange(ty_g, dtype=jnp.int32)
+    is_local = (bid >= t_off) & (bid < t_off + ty_l)
+    if skip:
+        act_l = jnp.any((frontier_l != 0).reshape(W_l, ty_l, rowblk * C),
+                        axis=(0, 2))
+        act_g = jnp.any(
+            (y_g != 0).reshape(y_g.shape[0], ty_g, rowblk * C),
+            axis=(0, 2))
+    else:
+        act_l = jnp.ones(ty_l, bool)
+        act_g = jnp.ones(ty_g, bool)
+    act_a = jax.lax.dynamic_update_slice(jnp.zeros(ty_g, bool), act_l,
+                                         (t_off,))
+    yidx_a, yact_a = skip_tables(idx_raw, act_a)
+    yidx_a = jnp.clip(yidx_a - t_off, 0, ty_l - 1)
+    yidx_b, yact_b = skip_tables(idx_raw, act_g & ~is_local)
+    return (yidx_a, yact_a), (yidx_b, yact_b)
+
+
 def _popcount_sum(words: jax.Array) -> jax.Array:
     return jnp.sum(jax.lax.population_count(words), dtype=jnp.int32)
 
@@ -708,6 +763,27 @@ class AlignedSimulator:
     #: sparse-exchange capacity per shard as a fraction of its packed
     #: words (FRONTIER_THRESHOLD_DEFAULT has the derivation).
     frontier_threshold: float = FRONTIER_THRESHOLD_DEFAULT
+    #: double-buffered DMA pipelining of the gossip kernels' y stream
+    #: (round 10): -1 auto (2 on the compiled TPU path, 0 under
+    #: interpret — the manual copy stream only adds interpreter work on
+    #: CPU, the frontier_mode precedent), 0 = the legacy BlockSpec
+    #: pipeline, 2 = the manual double-buffered stream
+    #: (ops/aligned_kernel.gossip_pass prefetch_depth).  Bitwise-
+    #: identical by construction, so it is excluded from checkpoint
+    #: fingerprints like fuse_update/frontier_mode.
+    prefetch_depth: int = 0
+    #: compute-hidden cross-chip exchange (round 10, sharded engines
+    #: only): split the push pass into a self-shard contribution (local
+    #: send words, no collective dependency) and a remote-shard
+    #: contribution (OR-seeded from the first via acc_init), so the
+    #: frontier/all-gather exchange overlaps the self-shard kernel on
+    #: hardware with async collectives.  -1 auto (on for the compiled
+    #: path), 0/1 force.  Needs the block-perm overlay (row-granular
+    #: permutations scatter every y block across shards) and a push
+    #: pass; resolves off otherwise.  Bitwise-identical: each grid step
+    #: contributes in exactly one of the two passes (complementary
+    #: yact gates) and OR is associative.
+    overlap_mode: int = 0
     seed: int = 0
     interpret: bool | None = None   # None -> interpret unless on TPU
 
@@ -830,6 +906,26 @@ class AlignedSimulator:
                  or (self.frontier_mode == -1 and not self.interpret))
         self._frontier_skip = fr_on and self.mode in ("push", "pushpull")
         self._frontier_delta = fr_on
+        # Round-10 schedule knobs (both bitwise-identical, both keyed
+        # off interpret on auto like frontier_mode): the manual
+        # double-buffered DMA stream, and the self/remote split that
+        # hides the sharded exchange behind the self-shard kernel.
+        if self.prefetch_depth not in (-1, 0, 2):
+            raise ValueError("prefetch_depth must be -1 (auto), 0, or 2")
+        self._prefetch = (2 if self.prefetch_depth == 2
+                          or (self.prefetch_depth == -1
+                              and not self.interpret) else 0)
+        if self.overlap_mode not in (-1, 0, 1):
+            raise ValueError("overlap_mode must be -1 (auto), 0, or 1")
+        # the split needs a push pass to split and the block-perm
+        # overlay's block-granular locality (a row-granular permutation
+        # scatters every y block's rows across all shards); it engages
+        # only when aligned_round actually runs sharded (n_shards > 1)
+        self._overlap = ((self.overlap_mode == 1
+                          or (self.overlap_mode == -1
+                              and not self.interpret))
+                         and self.topo.ytab is not None
+                         and self.mode in ("push", "pushpull"))
         # Liveness (strikes/rewire) runs whenever peers can die — without
         # churn no neighbor is ever observed dead, so the pass is skipped
         # statically and the strike plane is never allocated.
@@ -945,6 +1041,19 @@ class AlignedSimulator:
             clamps.append(
                 "frontier_mode 1 with mode=pull -> delta exchange only "
                 "(pure pull has no push pass to block-skip)")
+        # Round-10 schedule knobs: both bitwise-identical, so explicit
+        # values are always SAFE; a combination where the feature
+        # cannot exist is recorded, never silent (frontier precedent).
+        if cfg.overlap_mode == 1:
+            if cfg.mode == "pull":
+                clamps.append(
+                    "overlap_mode 1 with mode=pull -> 0 "
+                    "(no push pass to split into self/remote halves)")
+            elif not block_perm:
+                clamps.append(
+                    "overlap_mode 1 on a row-perm overlay -> 0 "
+                    "(the self/remote split needs the block-perm "
+                    "overlay's block-granular locality)")
         # n_msgs sizes the kernel's VMEM row block: wide message sets
         # shrink it (W * rowblk <= budget), and NARROW ones now widen it
         # up to MAX_CONFIG_ROWBLK — fewer grid steps and longer DMA
@@ -989,6 +1098,8 @@ class AlignedSimulator:
                            else None),
                    frontier_mode=cfg.frontier_mode,
                    frontier_threshold=cfg.frontier_threshold,
+                   prefetch_depth=cfg.prefetch_depth,
+                   overlap_mode=cfg.overlap_mode,
                    seed=cfg.prng_seed)
 
     # ------------------------------------------------------------------
@@ -1040,7 +1151,16 @@ class AlignedSimulator:
         slot8 = D * R * C                # one int8[D, R, 128] table
         fused = topo.ytab is not None
         fin = self.fuse_update
-        leak = topo.reuse_leak
+        # The gossip passes' partial-reuse leak depends on the stream
+        # implementation: the manual double-buffered stream issues no
+        # descriptor for a resident re-serve, so its leak is 0 by
+        # construction (Y_REUSE_LEAK_PREFETCH — the conservative
+        # direction for every number this model feeds); the liveness
+        # pass stays on the BlockSpec pipeline and keeps the
+        # calibrated κ.
+        leak = (Y_REUSE_LEAK_PREFETCH if self._prefetch
+                else topo.reuse_leak)
+        leak_live = topo.reuse_leak
         rolls = np.asarray(topo.rolls)
         ytab = None if topo.ytab is None else np.asarray(topo.ytab)
 
@@ -1057,12 +1177,13 @@ class AlignedSimulator:
                 push_active[np.floor(
                     np.arange(k_act) * T / k_act).astype(int)] = True
 
-        def y_eff(plan):
+        def y_eff(plan, lk=None):
             # calibrated partial reuse: full streams for index changes,
             # leak-fraction streams for resident-buffer re-serves
             # (skip-gated steps are re-serves of the pinned resident
             # block — same charge, so the model stays conservative)
-            return plan["y"] + leak * (plan["y_naive"] - plan["y"])
+            lk = leak if lk is None else lk
+            return plan["y"] + lk * (plan["y_naive"] - plan["y"])
 
         def pass_bytes(n_slots_d, final, seeded, active=None):
             plan = stream_plan(rolls, T, ytab=ytab, n_slots=n_slots_d,
@@ -1100,7 +1221,7 @@ class AlignedSimulator:
             terms["fanout_shift"] = R * C          # int8 shift plane
         if self._liveness:
             plan = stream_plan(rolls, T, ytab=ytab)
-            lv = (y_eff(plan) * blk * C * 4   # alive plane per y fetch
+            lv = (y_eff(plan, leak_live) * blk * C * 4   # alive plane
                   + 4 * slot8                 # colidx/strikes r+w
                   + 2 * slot8                 # evict8 write + reduce
                   + (plane if fused else 3 * plane))   # gather/prep
@@ -1119,6 +1240,16 @@ class AlignedSimulator:
         if self._frontier_skip and "push_pass" in terms:
             # the per-block activity reduce reads the send planes once
             terms["frontier_scan"] = wp
+        overlap = (self._overlap and n_shards > 1 and fused
+                   and "push_pass" in terms)
+        if overlap:
+            # the self/remote split's honest cost: the second grid walk
+            # re-streams the per-step tables (colidx + gate) and
+            # round-trips the pass-A accumulator through acc_init
+            plan = stream_plan(rolls, T, ytab=ytab)
+            terms["overlap_extra"] = (plan["tab"] * blk * C
+                                      + plan["row"] * blk * C + 2 * wp)
+        hidden = None
         if n_shards > 1 and self._frontier_delta:
             # interconnect bytes of the exchange, per chip per round
             # (the measure_round8 A/B's gathered-bytes column): the
@@ -1136,9 +1267,23 @@ class AlignedSimulator:
                 # round (the static byzantine plane gathers once at
                 # carry init and is amortized to ~0)
                 delta += plane
-            terms["delta_gather"] = delta
+            if overlap:
+                # the split moves the exchange off the critical path:
+                # its bytes land in ``overlap_hidden`` (reported,
+                # excluded from ``total`` — which only LOWERS every
+                # achieved_gb_s/roofline_frac built on it)
+                hidden = delta
+            else:
+                terms["delta_gather"] = delta
+        elif overlap:
+            # dense sharded exchange (never in ``total`` — it is
+            # interconnect, not HBM): report the hidden frontier-plane
+            # gather so the A/B can account what the split buys
+            hidden = wp
         terms = {k: int(v) for k, v in terms.items()}
         terms["total"] = sum(terms.values())
+        if hidden is not None:
+            terms["overlap_hidden"] = int(hidden)
         return terms
 
     def hbm_bytes_per_round(self) -> int:
@@ -1345,7 +1490,8 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
                   fr: FrontierCarry | None = None,
                   fr_axis: str | None = None,
                   fr_pmax_axes: tuple = (),
-                  fr_shards: int = 1):
+                  fr_shards: int = 1,
+                  n_shards: int = 1):
     """THE round implementation, shared by the single-chip engine,
     AlignedShardedSimulator (parallel/aligned_sharded.py) and the 2-D
     peers x message-planes engine (parallel/aligned_2d.py).
@@ -1386,6 +1532,13 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
         caller keeps the 3-tuple.  The fault plane's drop gates hash
         (receiver, slot, round) — never the transported words — so
         both paths see identical gate decisions by construction.
+      * ``n_shards`` — the peer-axis shard count (1 for the solo and
+        fleet engines).  With ``sim._overlap`` and a block-perm
+        overlay, ``n_shards > 1`` engages the compute-hidden exchange:
+        the push pass splits into a self-shard pass over the LOCAL
+        send planes (no collective dependency — the exchange overlaps
+        it on hardware) and a remote pass over the gathered planes,
+        OR-seeded via ``acc_init`` (:func:`_overlap_plans`).
     Everything else — churn, strikes/rewire, byzantine, gossip passes,
     metrics — is this one code path, so the engines cannot drift."""
     if msg_reduce is None:
@@ -1614,8 +1767,18 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
             if defer_w is not None:
                 send = send & ~defer_w[None]
             y = prow(gather(send))
+        # Compute-hidden exchange (round 10): the self/remote split
+        # engages only sharded, fused, and with the knob resolved on —
+        # pass A's plan depends on nothing gathered, so the collective
+        # that produced ``y`` overlaps it on hardware.
+        split = sim._overlap and n_shards > 1 and fused
         yidx = yact = None
-        if sim._frontier_skip:
+        yidx_a = yact_a = None
+        if split:
+            (yidx_a, yact_a), (yidx, yact) = _overlap_plans(
+                frontier_w, y, topo.rowblk, t_off, ytab_local,
+                skip=sim._frontier_skip)
+        elif sim._frontier_skip:
             # in-kernel block skipping: y blocks with no send bits this
             # round are gated off and never streamed — exact however
             # sparse or dense the frontier is (dead blocks OR in zero)
@@ -1633,11 +1796,32 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
         else:
             shift = None
         push_final = fin and sim.mode == "push"
+        acc0 = None
+        if split:
+            # Pass A: the self-shard contribution, from purely LOCAL
+            # operands (raw local send planes + the ungathered send
+            # mask) — traced with no dependency on the exchange, which
+            # is the whole overlap.  Remote steps are gated off; pass B
+            # gates the local ones off and OR-seeds from here.
+            ok_self = alive_w & ~state.byz_w
+            if defer_w is not None:
+                ok_self = ok_self & ~defer_w
+            acc0 = gossip_pass(frontier_w, topo.colidx, topo.deg,
+                               rolls_off, topo.subrolls, pull=False,
+                               fanout=sim.fanout, shift=shift,
+                               ytab=ytab_local, src_ok=ok_self,
+                               fault_meta=fmeta_push if kf else None,
+                               gbase=gbase_f if kf else None,
+                               yidx=yidx_a, yact=yact_a,
+                               prefetch_depth=sim._prefetch,
+                               rowblk=topo.rowblk,
+                               interpret=sim.interpret)
         recv = gossip_pass(y, topo.colidx, topo.deg, rolls_off,
                            topo.subrolls, pull=False, fanout=sim.fanout,
                            shift=shift,
                            ytab=ytab_local if fused else None,
                            src_ok=src_ok_push if fused else None,
+                           acc_init=acc0,
                            seen=seen_w if push_final else None,
                            rmask=rmask_w if push_final else None,
                            census_ok=ok_w if push_final else None,
@@ -1645,6 +1829,7 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
                            fault_meta=fmeta_push if kf else None,
                            gbase=gbase_f if kf else None,
                            yidx=yidx, yact=yact,
+                           prefetch_depth=sim._prefetch,
                            rowblk=topo.rowblk,
                            interpret=sim.interpret)
         if push_final:
@@ -1687,6 +1872,7 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
                              census_hmask=hmask if fin else None,
                              fault_meta=fmeta_pull if kf else None,
                              gbase=gbase_f if kf else None,
+                             prefetch_depth=sim._prefetch,
                              rowblk=topo.rowblk,
                              interpret=sim.interpret)
         if fin:

@@ -35,10 +35,13 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from p2p_gossipprotocol_tpu.aligned import (AlignedTopology, churn_rows,
-                                            row_uniform)
+from p2p_gossipprotocol_tpu.aligned import (AlignedTopology,
+                                            Y_REUSE_LEAK_PREFETCH,
+                                            churn_rows, row_uniform)
 from p2p_gossipprotocol_tpu.liveness import ChurnConfig
-from p2p_gossipprotocol_tpu.ops.aligned_kernel import LANES, count_pass
+from p2p_gossipprotocol_tpu.ops.aligned_kernel import (LANES, count_pass,
+                                                       gossip_pass,
+                                                       stream_plan)
 
 
 @struct.dataclass
@@ -69,6 +72,22 @@ class AlignedSIRSimulator:
     gamma: float = 0.1
     n_seeds: int = 1
     churn: ChurnConfig = None    # type: ignore[assignment]
+    #: fuse the pressure count into the gossip kernel (round 10): the
+    #: infectious-neighbor count rides gossip_pass's stream as its
+    #: ``press`` output instead of a second full D-slot count_pass
+    #: launch — on a block-perm overlay the host-side permute prep
+    #: (``jnp.take(..., perm)``, the model's 3-plane term) disappears
+    #: with it, one stream instead of two.  -1 auto (on for the
+    #: compiled path when the overlay carries ``ytab``, off under
+    #: interpret — the frontier_mode precedent), 0 = the solo
+    #: count_pass (kept as the entry point for callers with no gossip
+    #: stream to ride), 1 = force.  Bitwise-identical either way
+    #: (tests/test_sir_fuse.py), so it is excluded from checkpoint
+    #: fingerprints like fuse_update.
+    sir_fuse: int = 0
+    #: double-buffered DMA prefetch for the fused pass
+    #: (aligned.AlignedSimulator.prefetch_depth semantics).
+    prefetch_depth: int = 0
     seed: int = 0
     interpret: bool | None = None
 
@@ -87,6 +106,16 @@ class AlignedSIRSimulator:
                 f"aligned SIR on TPU needs >= 8 rows of {LANES} peers and "
                 f"an 8-aligned row block (this overlay: {self.topo.rows} "
                 f"rows, rowblk {self.topo.rowblk})")
+        if self.sir_fuse not in (-1, 0, 1):
+            raise ValueError("sir_fuse must be -1 (auto), 0, or 1")
+        self._fuse = (self.sir_fuse == 1
+                      or (self.sir_fuse == -1 and not self.interpret
+                          and self.topo.ytab is not None))
+        if self.prefetch_depth not in (-1, 0, 2):
+            raise ValueError("prefetch_depth must be -1 (auto), 0, or 2")
+        self._prefetch = (2 if self.prefetch_depth == 2
+                          or (self.prefetch_depth == -1
+                              and not self.interpret) else 0)
         self._scan_cache: dict = {}
 
     # ------------------------------------------------------------------
@@ -117,13 +146,20 @@ class AlignedSIRSimulator:
                              powerlaw_alpha=cfg.powerlaw_alpha,
                              n_shards=n_shards,
                              roll_groups=cfg.roll_groups or None,
-                             # honored for overlay-family parity; the
-                             # SIR round takes the legacy (prow) route
-                             # either way — count_pass is one flag
-                             # plane, so there is no 3W prep to fuse
+                             # honored for overlay-family parity; with
+                             # sir_fuse the block-perm table also lets
+                             # the fused pass delete the permute prep
+                             # (the flag plane rides ytab's index maps)
                              block_perm=cfg.block_perm > 0)
+        if cfg.sir_fuse == 1 and topo.ytab is None:
+            clamps.append(
+                "sir_fuse 1 on a row-perm overlay -> fused count only "
+                "(the permute prep stays host-side without block_perm; "
+                "the pass itself still fuses, bitwise-identically)")
         return cls(topo=topo, beta=cfg.sir_beta, gamma=cfg.sir_gamma,
                    churn=ChurnConfig(rate=cfg.churn_rate),
+                   sir_fuse=cfg.sir_fuse,
+                   prefetch_depth=cfg.prefetch_depth,
                    seed=cfg.prng_seed)
 
     # ------------------------------------------------------------------
@@ -145,6 +181,53 @@ class AlignedSIRSimulator:
             round=jnp.int32(0),
             n_peers=n,
         )
+
+    # ------------------------------------------------------------------
+    def traffic_model(self) -> dict:
+        """Per-term analytic HBM model of one SIR round (round 10) —
+        the same accounting discipline as the gossip engine's
+        (aligned.AlignedSimulator.traffic_model): kernel terms replay
+        the grid's DMA-descriptor sequence (stream_plan) with the
+        topology's calibrated partial-reuse leak (zero on the manual
+        prefetch stream, by construction); XLA-side passes are charged
+        one read+write per touched plane.
+
+        Terms: ``prep`` — the host-side permute-gather of the flag
+        plane (3 planes, the gossip model's per-pass prep rule); ZERO
+        on the fused path over a block-perm overlay, where the
+        permutation rides the ytab index maps — the deleted second
+        stream.  ``count_pass`` — the D-slot kernel walk: the flag
+        plane per effective y stream, colidx + gate once, the pressure
+        plane out; the fused pass adds one plane (the OR accumulator
+        riding along).  Pinned closed-form in
+        tests/test_traffic_model.py."""
+        topo = self.topo
+        R, D, C = topo.rows, topo.n_slots, LANES
+        blk = topo.rowblk
+        T = R // blk
+        plane = R * C * 4
+        fused_o = self._fuse and topo.ytab is not None
+        leak = (Y_REUSE_LEAK_PREFETCH
+                if self._fuse and self._prefetch else topo.reuse_leak)
+        plan = stream_plan(
+            np.asarray(topo.rolls), T,
+            ytab=np.asarray(topo.ytab) if fused_o else None)
+        eff = plan["y"] + leak * (plan["y_naive"] - plan["y"])
+        kern = (eff * blk * C * 4        # flag-plane streams
+                + plan["tab"] * blk * C  # colidx (int8)
+                + plan["row"] * blk * C  # gate (int8)
+                + plane)                 # pressure out
+        if self._fuse:
+            kern += plane                # the OR accumulator rides along
+        terms = {"prep": 0 if fused_o else 3 * plane,
+                 "count_pass": int(kern)}
+        terms["total"] = sum(terms.values())
+        return terms
+
+    def hbm_bytes_per_round(self) -> int:
+        """Total of :meth:`traffic_model` (bench/roofline parity with
+        the gossip engine)."""
+        return self.traffic_model()["total"]
 
     # ------------------------------------------------------------------
     def step(self, state: AlignedSIRState,
@@ -209,10 +292,34 @@ def aligned_sir_round(sim: AlignedSIRSimulator, state: AlignedSIRState,
 
     transmitting = jnp.where(state.inf_b & alive_b, jnp.int32(-1),
                              jnp.int32(0))
-    y = jnp.take(gather(transmitting), topo.perm, axis=0)
-    pressure = count_pass(y, topo.colidx, topo.deg, topo.rolls + t_off,
-                          topo.subrolls, rowblk=topo.rowblk,
-                          interpret=sim.interpret)
+    if sim._fuse:
+        # Fused pressure (round 10): ONE gossip_pass streams the flag
+        # plane and emits the infectious-neighbor count as its press
+        # output — on a block-perm overlay the permutation rides the
+        # ytab index maps, so the host-side permute prep below does not
+        # exist at all (one stream instead of two); bitwise-equal to
+        # the solo count_pass (tests/test_sir_fuse.py).
+        fused_o = topo.ytab is not None
+        if fused_o:
+            t_local = state.inf_b.shape[0] // topo.rowblk
+            ytab_local = jax.lax.dynamic_slice(
+                topo.ytab, (jnp.int32(0), jnp.int32(t_off)),
+                (topo.ytab.shape[0], t_local))
+            y = gather(transmitting)
+        else:
+            y = jnp.take(gather(transmitting), topo.perm, axis=0)
+        _, pressure = gossip_pass(
+            y[None], topo.colidx, topo.deg, topo.rolls + t_off,
+            topo.subrolls, press=True,
+            ytab=ytab_local if fused_o else None,
+            prefetch_depth=sim._prefetch,
+            rowblk=topo.rowblk, interpret=sim.interpret)
+    else:
+        y = jnp.take(gather(transmitting), topo.perm, axis=0)
+        pressure = count_pass(y, topo.colidx, topo.deg,
+                              topo.rolls + t_off,
+                              topo.subrolls, rowblk=topo.rowblk,
+                              interpret=sim.interpret)
     p_infect = 1.0 - jnp.power(jnp.float32(1.0 - sim.beta),
                                pressure.astype(jnp.float32))
     u = row_uniform(k_u, grows, (2, LANES))

@@ -124,6 +124,20 @@ _SIM_INT_KEYS = {
     # dense path by construction (docs/ARCHITECTURE.md "The frontier
     # seam").
     "frontier_mode": "frontier_mode",
+    # aligned engine: double-buffered DMA pipelining of the gossip
+    # kernels' sender stream — 2 = the manual copy stream (block k+1
+    # prefetches while k computes), 0 = the legacy BlockSpec pipeline,
+    # -1 (default) = auto (on for the compiled path only, the
+    # frontier_mode rule).  Bitwise-identical either way.
+    "prefetch_depth": "prefetch_depth",
+    # aligned engine, sharded meshes: hide the cross-chip exchange
+    # behind the self-shard half of the push kernel — -1 auto / 0 / 1
+    # (needs a block-perm overlay and a push pass; degrades recorded).
+    "overlap_mode": "overlap_mode",
+    # aligned SIR engine: fuse the infectious-neighbor pressure count
+    # into the gossip kernel's stream (one stream instead of the
+    # permute prep + solo count_pass pair) — -1 auto / 0 / 1.
+    "sir_fuse": "sir_fuse",
     "rounds": "rounds",
     "prng_seed": "prng_seed",
     # jax backend: rounds between successive message activations —
@@ -298,6 +312,16 @@ class NetworkConfig:
         # its bitmap+scatter overhead; 2*K words of idx+val vs L words
         # dense -> a 1/64 cap bounds the sparse gather at ~3% of dense).
         self.frontier_threshold = 1.0 / 64.0
+        # Round-10 schedule knobs, all -1 = AUTO (engaged on the
+        # compiled TPU path, off under interpret — the frontier_mode
+        # rule; all three are bitwise-identical to the legacy schedule,
+        # so forcing any of them on is always SAFE):
+        # double-buffered DMA prefetch of the kernels' sender stream,
+        # the self/remote split that hides the sharded exchange behind
+        # compute, and the fused SIR pressure count.
+        self.prefetch_depth = -1
+        self.overlap_mode = -1
+        self.sir_fuse = -1
         self.rounds = 0
         self.message_stagger = 0       # 0 = all rumors at round 0
         self.mesh_devices = 0          # 0/1 = single device
@@ -488,6 +512,14 @@ class NetworkConfig:
             raise ConfigError("frontier_mode must be -1 (auto), 0, or 1")
         if not (0.0 < self.frontier_threshold <= 1.0):
             raise ConfigError("frontier_threshold must be in (0, 1]")
+        if self.prefetch_depth not in (-1, 0, 2):
+            raise ConfigError(
+                "prefetch_depth must be -1 (auto), 0 (pipelined), or 2 "
+                "(double-buffered manual stream)")
+        if self.overlap_mode not in (-1, 0, 1):
+            raise ConfigError("overlap_mode must be -1 (auto), 0, or 1")
+        if self.sir_fuse not in (-1, 0, 1):
+            raise ConfigError("sir_fuse must be -1 (auto), 0, or 1")
         # msg_shards/mesh_devices CROSS-field rules are deliberately not
         # checked here: CLI flags may override engine/mode/mesh after
         # load, so the combination is validated at engine-selection time

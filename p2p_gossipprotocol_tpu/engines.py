@@ -163,7 +163,11 @@ def config_keys(cfg, n_peers: int | None = None) -> dict:
     and the ``frontier_*`` keys, whose sparse execution path is
     bitwise-identical to the dense one by seen-set monotonicity
     (tests/test_frontier.py), so a checkpoint migrates freely between
-    frontier-sparse and dense readers.  The ``supervise_*`` keys are
+    frontier-sparse and dense readers.  The round-10 schedule keys
+    (``prefetch_depth``, ``overlap_mode``, ``sir_fuse``) are excluded
+    on the same bitwise-identity grounds (tests/test_prefetch.py,
+    test_overlap.py, test_sir_fuse.py): they pick HOW the same blocks
+    move, never what the round computes.  The ``supervise_*`` keys are
     likewise excluded: supervision decides WHERE a run executes (how
     many worker processes, what deadlines), never its trajectory — a
     checkpoint written under supervision must resume unsupervised and
@@ -294,7 +298,8 @@ def build_simulator(cfg, *, n_peers: int | None = None,
                 sim = AlignedShardedSIRSimulator(
                     mesh=make_mesh(n_shards), topo=sim.topo,
                     beta=sim.beta, gamma=sim.gamma, n_seeds=sim.n_seeds,
-                    churn=sim.churn, seed=sim.seed)
+                    churn=sim.churn, sir_fuse=sim.sir_fuse,
+                    prefetch_depth=sim.prefetch_depth, seed=sim.seed)
                 return sim, f"aligned-sharded-{n_shards}"
             return sim, "aligned"
         if n_shards > 1:
@@ -330,6 +335,8 @@ def build_simulator(cfg, *, n_peers: int | None = None,
             faults=sim.faults,
             frontier_mode=sim.frontier_mode,
             frontier_threshold=sim.frontier_threshold,
+            prefetch_depth=sim.prefetch_depth,
+            overlap_mode=sim.overlap_mode,
             seed=sim.seed)
         if msg_shards > 1:
             # 2-D mesh: message planes x peer rows (the SP analogue,

@@ -46,6 +46,12 @@ def bucket_signature(sim) -> tuple:
         # alone decides whether the skip tables enter the trace (the
         # delta exchange never runs on the fleet's single device)
         sim._frontier_skip,
+        # resolved round-10 schedule statics: the prefetch stream
+        # changes the compiled kernel (scratch ring + manual DMA); the
+        # overlap split never engages on the fleet's single device but
+        # stays in the signature for the same one-program-per-bucket
+        # discipline
+        sim._prefetch, sim._overlap,
         sim._liveness,
         (sim.churn.rate, sim.churn.revive, sim.churn.kill_round),
         sim.faults,            # frozen dataclass or None — hashable

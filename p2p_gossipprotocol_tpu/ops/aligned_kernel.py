@@ -57,6 +57,26 @@ from jax.experimental.pallas import tpu as pltpu
 LANES = 128
 
 
+def grid_y_index(t, d, rolls, ty_blocks, ytab=None, yidx=None):
+    """THE y-block index rule for grid step (row-block ``t``, slot
+    ``d``) — the single definition behind (a) the BlockSpec index maps
+    :func:`gossip_pass` installs, (b) the in-kernel double-buffered
+    prefetch stream's current/next-block lookups, and (c) the host-side
+    descriptor replay (:func:`stream_plan`) the traffic model and the
+    drift-guard suite (tests/test_stream_plan.py) consume.  Priority:
+    a frontier skip remap (``yidx``, already composed with any overlay
+    table) wins, then the block-perm composed table (``ytab``), then
+    the row-perm roll rule.  ``rolls``/``ytab``/``yidx`` may be numpy
+    arrays (host replay) or SMEM refs (inside the kernel) — only
+    indexing and integer arithmetic are used, so one rule serves all
+    three consumers and they cannot drift."""
+    if yidx is not None:
+        return yidx[d, t]
+    if ytab is not None:
+        return ytab[d, t]
+    return (t + rolls[d]) % ty_blocks
+
+
 def _fold8(x):
     """(blk, 128) int32 -> one (8, 128) partial-sum tile: sublane s holds
     the sum over rows r ≡ s (mod 8) — the census outputs' on-chip layout
@@ -72,12 +92,15 @@ def _fold8(x):
     return jnp.where(row == 0, jnp.broadcast_to(tot, (8, C)), 0)
 
 
-def _pass_kernel(pull: bool, n_planes: int, fanout: int, masked: bool,
-                 has_init: bool, finalize: bool, census: bool,
-                 faulty: bool, skipped: bool, n_pref: int, *refs):
+def _pass_kernel(pull: bool, n_planes: int, fanout: int, fused: bool,
+                 masked: bool, has_init: bool, finalize: bool,
+                 census: bool, faulty: bool, skipped: bool, press: bool,
+                 pref2: bool, ty_blocks: int, n_pref: int, *refs):
     pref, rest = refs[:n_pref], refs[n_pref:]
-    subrolls_ref = pref[1]        # pref[0]=rolls, pref[2]=ytab (fused)
-    base = 3 if masked else 2     # slots taken by rolls/subrolls[/ytab]
+    rolls_ref, subrolls_ref = pref[0], pref[1]
+    ytab_ref = pref[2] if fused else None
+    base = 3 if fused else 2      # slots taken by rolls/subrolls[/ytab]
+    yidx_ref = pref[base] if skipped else None
     if skipped:
         # Frontier block-skip tables (int32[D, T] scalar prefetch):
         # pref[base] is the REMAPPED y index table (dead sender blocks
@@ -130,10 +153,31 @@ def _pass_kernel(pull: bool, n_planes: int, fanout: int, masked: bool,
         cok_ref = rest[i]
         i += 1
     acc_ref = rest[i]
+    n_out = 1
     if finalize:
         seen_out_ref = rest[i + 1]
+        n_out = 2
     if census:
         deliv_out_ref, cov_out_ref = rest[i + 2], rest[i + 3]
+        n_out = 4
+    if press:
+        # SIR pressure plane (an additional output of the final slot):
+        # a SUM accumulator over plane 0's gathered flags, resident in
+        # VMEM alongside acc_ref — one grid walk serves both.
+        press_ref = rest[i + n_out]
+        n_out += 1
+    if pref2:
+        # Manual double-buffered DMA stream (prefetch_depth=2): y (and,
+        # fused, src_ok) arrive as whole HBM refs; the scratch ring
+        # below holds the resident and in-flight blocks.
+        s0 = i + n_out
+        ybuf, ysem = rest[s0], rest[s0 + 1]
+        s0 += 2
+        if masked:
+            okbuf, oksem = rest[s0], rest[s0 + 1]
+            s0 += 2
+        slot_ref = rest[s0]
+    t = pl.program_id(0)
     d = pl.program_id(1)
     # Per-slot sublane roll: out-row i reads y-row (i + s_d) % blk, so a
     # peer's D slots see D distinct source rows even when the grid has a
@@ -142,7 +186,68 @@ def _pass_kernel(pull: bool, n_planes: int, fanout: int, masked: bool,
     # pltpu.roll(x, s) moves row i to i+s, i.e. out-row i sees row i-s —
     # so rolling by -s_d would READ row i+s_d; jnp.roll has the same
     # convention but its dynamic-shift form doesn't lower on Mosaic.
-    blk = y_ref.shape[1]
+    blk = col_ref.shape[1]       # y_ref is a whole HBM ref under pref2
+    if pref2:
+        # Double-buffered prefetch: the y (and src_ok) block for the
+        # NEXT distinct grid index is DMA'd into the free half of the
+        # scratch ring while this step computes from the resident half.
+        # The issue discipline is exactly stream_plan's dedup rule —
+        # one copy per index CHANGE, none for resident re-serves (skip-
+        # remapped dead steps pin their index, so they never copy) —
+        # and the current/previous/next indices all come from
+        # :func:`grid_y_index`, the same rule the BlockSpec maps
+        # install, so the stream cannot drift from the model's replay.
+        def _yi(tt, dd):
+            return grid_y_index(tt, dd, rolls_ref, ty_blocks,
+                                ytab=ytab_ref, yidx=yidx_ref)
+
+        def _copies(idx, s):
+            cps = [pltpu.make_async_copy(
+                y_ref.at[:, pl.ds(idx * blk, blk), :], ybuf.at[s],
+                ysem.at[s])]
+            if masked:
+                cps.append(pltpu.make_async_copy(
+                    ok_ref.at[pl.ds(idx * blk, blk)], okbuf.at[s],
+                    oksem.at[s]))
+            return cps
+
+        nT, nD = pl.num_programs(0), pl.num_programs(1)
+        step = pl.program_id(0) * nD + d
+        cur = _yi(t, d)
+        prv = _yi(jnp.maximum(jnp.where(d == 0, t - 1, t), 0),
+                  jnp.where(d == 0, nD - 1, d - 1))
+        changed = (step == 0) | (cur != prv)
+
+        @pl.when(step == 0)
+        def _():
+            # no earlier step could look ahead for us: issue + wait
+            # in-line (the one unoverlapped copy of the pass)
+            slot_ref[0] = 0
+            for cp in _copies(cur, 0):
+                cp.start()
+
+        @pl.when((step > 0) & (cur != prv))
+        def _():
+            slot_ref[0] = 1 - slot_ref[0]
+
+        slot = slot_ref[0]
+
+        @pl.when(changed)
+        def _():
+            for cp in _copies(cur, slot):
+                cp.wait()
+
+        # Lookahead: only the LAST step of a resident run sees a
+        # different next index, so exactly one copy is issued per index
+        # change — into the half the compute is not reading.
+        nxt = _yi(jnp.minimum(jnp.where(d == nD - 1, t + 1, t), nT - 1),
+                  jnp.where(d == nD - 1, 0, d + 1))
+
+        @pl.when((step < nT * nD - 1) & (nxt != cur))
+        def _():
+            for cp in _copies(nxt, 1 - slot):
+                cp.start()
+
     col = col_ref[0].astype(jnp.int32)
     g = gate_ref[:].astype(jnp.int32)
     if pull:
@@ -181,15 +286,24 @@ def _pass_kernel(pull: bool, n_planes: int, fanout: int, masked: bool,
         # the data — makes the contribution zero
         mask = mask & (yact_ref[d, pl.program_id(0)] != 0)
     if masked:
+        ok_words = okbuf[slot] if pref2 else ok_ref[:]
         okv = jnp.take_along_axis(
-            pltpu.roll(ok_ref[:], blk - subrolls_ref[d], axis=0),
+            pltpu.roll(ok_words, blk - subrolls_ref[d], axis=0),
             col, axis=1)
     # Static unroll over message planes: col/gate/ok stay resident, each
     # plane costs one sublane roll + one lane-wise dynamic_gather.
     n_slots = pl.num_programs(1)
+    pz = None
     for w in range(n_planes):
-        y = pltpu.roll(y_ref[w], blk - subrolls_ref[d], axis=0)
+        yw = ybuf[slot, w] if pref2 else y_ref[w]
+        y = pltpu.roll(yw, blk - subrolls_ref[d], axis=0)
         zw = jnp.take_along_axis(y, col, axis=1)
+        if press and w == 0:
+            # infectious-neighbor pressure: plane 0 is a flag plane
+            # (-1 transmitting / 0), so the gathered word's low bit IS
+            # the count contribution — the solo count_pass's z, from
+            # the gather this pass already paid for
+            pz = jnp.where(mask, zw & 1, 0)
         if masked:
             zw = zw & okv
         z = jnp.where(mask, zw, 0)
@@ -201,6 +315,15 @@ def _pass_kernel(pull: bool, n_planes: int, fanout: int, masked: bool,
         @pl.when(d > 0)
         def _(w=w, z=z):
             acc_ref[w] = acc_ref[w] | z
+
+    if press:
+        @pl.when(d == 0)
+        def _():
+            press_ref[:] = pz
+
+        @pl.when(d > 0)
+        def _():
+            press_ref[:] = press_ref[:] + pz
 
     if finalize:
         @pl.when(d == n_slots - 1)
@@ -246,6 +369,8 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
                 gbase: jax.Array | None = None,
                 yidx: jax.Array | None = None,
                 yact: jax.Array | None = None,
+                press: bool = False,
+                prefetch_depth: int = 0,
                 rowblk: int = 512,
                 interpret: bool = False):
     """One OR-accumulated D-slot pass over W message planes.
@@ -321,8 +446,25 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
                 variant (the fused path's ``src_ok`` block rides the
                 same remapped index, so no extra DMA is issued for it
                 either).
-    Returns int32[W, R, 128]: words each peer hears this pass — or the
-    pair ``(new, seen')`` when ``seen`` is given.
+    ``press`` — emit plane 0's gathered low bits as a SUM-accumulated
+                pressure plane (int32[R, 128]) alongside the OR output:
+                the SIR model's infectious-neighbor count from the
+                stream this pass already pays for, bitwise-equal to the
+                solo :func:`count_pass` (which stays the entry point
+                for callers with no gossip pass to ride).  Push-gated
+                flood only (``d < gate``) — asserts no pull/fanout/
+                fault/finalize composition.
+    ``prefetch_depth`` — 2 = manual double-buffered DMA pipelining of
+                the y (and, fused, src_ok) stream: the block for grid
+                step k+1 prefetches while step k computes, with copies
+                issued by exactly stream_plan's dedup rule (one per
+                index change — resident re-serves, including skip-
+                remapped dead steps, issue nothing).  0/1 = the legacy
+                BlockSpec-pipelined stream.  Bitwise-identical by
+                construction: the same blocks reach the same compute.
+    Returns int32[W, R, 128]: words each peer hears this pass — the
+    pair ``(new, seen')`` when ``seen`` is given, or the pair
+    ``(words, pressure)`` when ``press`` is set.
     """
     W, Ry, C = y.shape
     assert C == LANES, f"lane dim must be {LANES}, got {C}"
@@ -333,10 +475,22 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
     Ty = Ry // blk        # y (possibly global) row blocks
     fanout = 0 if pull else fanout
     fused = ytab is not None
+    masked = src_ok is not None
     finalize = seen is not None
     census = census_hmask is not None
     faulty = fault_meta is not None
     skipped = yidx is not None
+    if prefetch_depth not in (0, 1, 2):
+        raise ValueError("prefetch_depth must be 0/1 (pipelined) or 2 "
+                         "(manual double-buffered stream)")
+    pref2 = prefetch_depth == 2
+    if press:
+        assert not pull and fanout == 0, "press is push-gated flood only"
+        assert not finalize and not faulty and acc_init is None, \
+            "press does not compose with finalize/fault/seeded passes"
+    assert masked or not fused or press, \
+        "block-perm pass needs the src_ok mask"
+    assert fused or not masked, "src_ok rides the ytab index maps"
     if finalize:
         assert rmask is not None, "in-kernel seen-update needs rmask"
     if census:
@@ -351,29 +505,37 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
         assert yidx.shape == (D, T), (yidx.shape, (D, T))
         assert yact.shape == (D, T), (yact.shape, (D, T))
     # Index maps take ``*_`` so the optional skip/census/fault prefetch
-    # operands (appended below) never change their arity.
+    # operands (appended below) never change their arity.  Every y/ok
+    # map routes through :func:`grid_y_index` — THE index rule the
+    # prefetch stream and the traffic model's replay share, so the
+    # three consumers cannot drift (tests/test_stream_plan.py).
     if fused:
-        assert src_ok is not None, "block-perm pass needs the src_ok mask"
         assert ytab.shape == (D, T), (ytab.shape, (D, T))
         n_pref = 3
         prefetch = (rolls, subrolls, ytab)
         if skipped:
             # the remap table already composes perm∘roll (it was built
             # FROM ytab), so it simply replaces ytab in the y/ok maps
-            y_map = lambda t, d, k, s, yt, yi, *_: (0, yi[d, t], 0)
-            ok_map = lambda t, d, k, s, yt, yi, *_: (yi[d, t], 0)
+            y_map = lambda t, d, k, s, yt, yi, *_: (
+                0, grid_y_index(t, d, k, Ty, ytab=yt, yidx=yi), 0)
+            ok_map = lambda t, d, k, s, yt, yi, *_: (
+                grid_y_index(t, d, k, Ty, ytab=yt, yidx=yi), 0)
         else:
-            y_map = lambda t, d, k, s, yt, *_: (0, yt[d, t], 0)
-            ok_map = lambda t, d, k, s, yt, *_: (yt[d, t], 0)
+            y_map = lambda t, d, k, s, yt, *_: (
+                0, grid_y_index(t, d, k, Ty, ytab=yt), 0)
+            ok_map = lambda t, d, k, s, yt, *_: (
+                grid_y_index(t, d, k, Ty, ytab=yt), 0)
         tab_map = lambda t, d, k, s, yt, *_: (d, t, 0)
         row_map = lambda t, d, k, s, yt, *_: (t, 0)
     else:
         n_pref = 2
         prefetch = (rolls, subrolls)
         if skipped:
-            y_map = lambda t, d, k, s, yi, *_: (0, yi[d, t], 0)
+            y_map = lambda t, d, k, s, yi, *_: (
+                0, grid_y_index(t, d, k, Ty, yidx=yi), 0)
         else:
-            y_map = lambda t, d, k, s, *_: (0, (t + k[d]) % Ty, 0)
+            y_map = lambda t, d, k, s, *_: (
+                0, grid_y_index(t, d, k, Ty), 0)
         tab_map = lambda t, d, k, s, *_: (d, t, 0)
         row_map = lambda t, d, k, s, *_: (t, 0)
     if skipped:
@@ -388,14 +550,23 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
     if faulty:
         prefetch = prefetch + (gbase, fault_meta)
         n_pref += 2
+    if pref2:
+        # y (and src_ok) stay whole in HBM; the kernel's scratch ring
+        # and its grid_y_index-driven copy stream replace the BlockSpec
+        # pipeline for exactly these operands.
+        y_spec = pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)
+        ok_spec = pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)
+    else:
+        y_spec = pl.BlockSpec((W, blk, C), y_map)
+        ok_spec = pl.BlockSpec((blk, C), ok_map) if masked else None
     in_specs = [
-        pl.BlockSpec((W, blk, C), y_map),
+        y_spec,
         pl.BlockSpec((1, blk, C), tab_map),
         pl.BlockSpec((blk, C), row_map),
     ]
     operands = [y, colidx, gate]
-    if fused:
-        in_specs.append(pl.BlockSpec((blk, C), ok_map))
+    if masked:
+        in_specs.append(ok_spec)
         operands.append(src_ok)
     if fanout > 0:
         assert shift is not None, "bounded fanout needs a shift plane"
@@ -428,24 +599,39 @@ def gossip_pass(y: jax.Array, colidx: jax.Array, gate: jax.Array,
             out_shape += [jax.ShapeDtypeStruct((T, 8, C), jnp.int32),
                           jax.ShapeDtypeStruct((T, 8, C), jnp.int32)]
     else:
-        out_specs = pl.BlockSpec((W, blk, C), acc_map)
-        out_shape = jax.ShapeDtypeStruct((W, R, C), jnp.int32)
+        out_specs = [pl.BlockSpec((W, blk, C), acc_map)]
+        out_shape = [jax.ShapeDtypeStruct((W, R, C), jnp.int32)]
+        if press:
+            # the pressure plane: d-constant SUM accumulator, emitted
+            # with the final slot like the census tiles
+            out_specs.append(pl.BlockSpec((blk, C), row_map))
+            out_shape.append(jax.ShapeDtypeStruct((R, C), jnp.int32))
+
+    scratch = []
+    if pref2:
+        scratch = [pltpu.VMEM((2, W, blk, C), jnp.int32),
+                   pltpu.SemaphoreType.DMA((2,))]
+        if masked:
+            scratch += [pltpu.VMEM((2, blk, C), jnp.int32),
+                        pltpu.SemaphoreType.DMA((2,))]
+        scratch.append(pltpu.SMEM((1,), jnp.int32))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=n_pref,
         grid=(T, D),
         in_specs=in_specs,
         out_specs=out_specs,
+        scratch_shapes=scratch,
     )
     out = pl.pallas_call(
-        functools.partial(_pass_kernel, pull, W, fanout, fused,
+        functools.partial(_pass_kernel, pull, W, fanout, fused, masked,
                           acc_init is not None, finalize, census, faulty,
-                          skipped, n_pref),
+                          skipped, press, pref2, Ty, n_pref),
         grid_spec=grid_spec,
         out_shape=out_shape,
         interpret=interpret,
     )(*prefetch, *operands)
-    return tuple(out) if finalize else out
+    return tuple(out) if (finalize or press) else out[0]
 
 
 def _count_kernel(rolls_ref, subrolls_ref, y_ref, col_ref, gate_ref,
@@ -752,7 +938,11 @@ def stream_plan(rolls, t_blocks: int, ty_blocks: int | None = None,
     pull-window grid); ``ty_blocks`` covers the sharded case where the
     y planes span more blocks than the local output grid; ``active``
     (bool per y block) replays :func:`skip_tables`'s remap rule — a
-    dead step keeps the previous step's index, so it never fetches."""
+    dead step keeps the previous step's index, so it never fetches,
+    EXCEPT that steps before the first active one pin to step 0's raw
+    index, which both the BlockSpec pipeline and the prefetch stream
+    fetch once (the gate zeroes its contribution; the model charges
+    the copy honestly rather than pretending it away)."""
     rolls = np.asarray(rolls)
     D = len(rolls) if n_slots is None else n_slots
     T = t_blocks
@@ -762,13 +952,17 @@ def stream_plan(rolls, t_blocks: int, ty_blocks: int | None = None,
     fetches = 0
     skipped = 0
     last = None
+    pin = None
     for t in range(T):
         for d in range(D):
-            i = (int(yt[d, t]) if yt is not None
-                 else int((t + rolls[d]) % Ty))
-            if act is not None and not act[i]:
-                skipped += 1          # index pinned to ``last``: no DMA
-                continue
+            raw = int(grid_y_index(t, d, rolls, Ty, ytab=yt))
+            if pin is None:
+                pin = raw             # step 0's raw index (the leading
+            if act is not None and not act[raw]:        # pin target)
+                skipped += 1
+                i = last if last is not None else pin
+            else:
+                i = raw
             if i != last:
                 fetches += 1
                 last = i

@@ -103,6 +103,11 @@ class Aligned2DShardedSimulator:
     #: the same branch of the compiled conditional.
     frontier_mode: int = 0
     frontier_threshold: float = None  # type: ignore[assignment]
+    #: round-10 schedule knobs (aligned.AlignedSimulator): the msg axis
+    #: is exchange-free, so the overlap split applies to the peer-axis
+    #: gather exactly as on the 1-D engine.
+    prefetch_depth: int = 0
+    overlap_mode: int = 0
     seed: int = 0
     interpret: bool | None = None
 
@@ -124,6 +129,8 @@ class Aligned2DShardedSimulator:
             fuse_update=self.fuse_update,
             pull_window=self.pull_window, faults=self.faults,
             frontier_mode=self.frontier_mode, **fr_kw,
+            prefetch_depth=self.prefetch_depth,
+            overlap_mode=self.overlap_mode,
             seed=self.seed,
             interpret=self.interpret)
         self.churn = self._inner.churn
@@ -224,7 +231,7 @@ class Aligned2DShardedSimulator:
             msg_reduce=lambda x: jax.lax.psum(x, (MSG_AXIS, PEER_AXIS)),
             honest_mask=hmask, junk_mask=jmask, w_off=w0,
             msg_only_reduce=lambda x: jax.lax.psum(x, MSG_AXIS),
-            **fr_kw)
+            n_shards=self.n_peer_shards, **fr_kw)
 
     # ------------------------------------------------------------------
     def run(self, rounds: int, state: AlignedState | None = None,

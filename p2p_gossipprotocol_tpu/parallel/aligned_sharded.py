@@ -101,6 +101,13 @@ class AlignedShardedSimulator:
     #: bitwise-identical to the dense path, regime switch included.
     frontier_mode: int = 0
     frontier_threshold: float = None  # type: ignore[assignment]
+    #: round-10 schedule knobs (aligned.AlignedSimulator): the manual
+    #: double-buffered DMA stream, and the self/remote push-pass split
+    #: that hides this engine's per-round exchange behind the
+    #: self-shard kernel — both bitwise-identical to the legacy
+    #: schedule (tests/test_prefetch.py / test_overlap.py).
+    prefetch_depth: int = 0
+    overlap_mode: int = 0
     seed: int = 0
     interpret: bool | None = None
 
@@ -129,6 +136,8 @@ class AlignedShardedSimulator:
             pull_window=self.pull_window,
             faults=self.faults,
             frontier_mode=self.frontier_mode, **fr_kw,
+            prefetch_depth=self.prefetch_depth,
+            overlap_mode=self.overlap_mode,
             seed=self.seed, interpret=self.interpret)
         self.churn = self._inner.churn
         self.interpret = self._inner.interpret
@@ -219,7 +228,8 @@ class AlignedShardedSimulator:
             # words, axis 1 of the 3D [W, rows, 128] message planes
             gather=lambda x: jax.lax.all_gather(x, AXIS, axis=x.ndim - 2,
                                                 tiled=True),
-            reduce=lambda x: jax.lax.psum(x, AXIS), **fr_kw)
+            reduce=lambda x: jax.lax.psum(x, AXIS),
+            n_shards=self.n_shards, **fr_kw)
 
     # ------------------------------------------------------------------
     def _specs(self):
@@ -373,6 +383,11 @@ class AlignedShardedSIRSimulator:
     gamma: float = 0.1
     n_seeds: int = 1
     churn: ChurnConfig = None    # type: ignore[assignment]
+    #: fused pressure + DMA prefetch (aligned_sir.AlignedSIRSimulator)
+    #: — the shared aligned_sir_round reads the resolved flags off the
+    #: inner sim, so the sharded engine inherits both bitwise.
+    sir_fuse: int = 0
+    prefetch_depth: int = 0
     seed: int = 0
     interpret: bool | None = None
 
@@ -388,8 +403,9 @@ class AlignedShardedSIRSimulator:
                 f"build_aligned(..., n_shards={self.n_shards})")
         self._inner = AlignedSIRSimulator(
             topo=self.topo, beta=self.beta, gamma=self.gamma,
-            n_seeds=self.n_seeds, churn=self.churn, seed=self.seed,
-            interpret=self.interpret)
+            n_seeds=self.n_seeds, churn=self.churn,
+            sir_fuse=self.sir_fuse, prefetch_depth=self.prefetch_depth,
+            seed=self.seed, interpret=self.interpret)
         self.churn = self._inner.churn
         self.interpret = self._inner.interpret
         self._scan_cache: dict = {}

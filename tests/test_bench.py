@@ -48,6 +48,15 @@ def test_bench_cpu_run_is_labeled_and_complete():
     assert "16384" in rec["metric"]             # peer count in the name
     assert rec["vs_baseline"] is None           # not the 1M-TPU config
     assert rec["fallback"] is False
+    # round-10 roofline column: present as a first-class field, and
+    # REPRODUCIBLE from the row alone — the recorded roof + model bytes
+    # + wall recompute the fraction exactly (same provenance discipline
+    # as achieved_gb_s: this run's numbers, never a recorded row's)
+    assert rec["roof_gb_s"] > 0
+    expect = (rec["bytes_per_round"] * rec["rounds"]
+              / rec["value"] / 1e9 / rec["roof_gb_s"])
+    assert abs(rec["roofline_frac"] - expect) <= 1e-4 + 0.01 * expect
+    assert rec["achieved_gb_s"] is not None
 
 
 def test_bench_falls_back_to_cpu_when_backend_init_fails():

@@ -1,0 +1,134 @@
+"""Round-10 drift guard: stream_plan vs the index maps the kernel
+actually installs.
+
+``stream_plan`` is the traffic model's DMA-descriptor ground truth;
+the kernel's BlockSpec maps, its frontier skip remaps
+(``skip_tables``), and the round-10 prefetch stream all derive their
+per-step y index from ``grid_y_index``.  Before this guard the model
+and the kernel could silently drift — stream_plan hand-rolled its own
+copy of the index rules.  These tests replay the grid EXACTLY as the
+kernel walks it — grid_y_index over the installed ``yidx`` remap, and
+the prefetch stream's issue rule (one copy at step 0, one per index
+change) — and fail if the model's descriptor sequence diverges.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from p2p_gossipprotocol_tpu.ops.aligned_kernel import (grid_y_index,
+                                                       skip_tables,
+                                                       stream_plan)
+
+
+def _installed_index_seq(rolls, T, Ty, ytab=None, active=None,
+                         n_slots=None):
+    """The per-grid-step y index the kernel REALLY uses: the raw
+    BlockSpec rule when no skip tables ride, else the ``yidx`` remap
+    built by the same ``skip_tables`` the engines install.  Walked in
+    grid order (t-major, d innermost) — the order the pipeline and the
+    prefetch stream both serve."""
+    D = len(rolls) if n_slots is None else n_slots
+    if active is None:
+        yidx = None
+    else:
+        t = np.arange(T)[:, None]
+        raw = (np.asarray(ytab).T[:, :D] if ytab is not None
+               else (t + np.asarray(rolls)[None, :D]) % Ty)
+        yidx = np.asarray(skip_tables(jnp.asarray(raw.astype(np.int32)),
+                                      jnp.asarray(active))[0])
+    return [int(grid_y_index(t, d, np.asarray(rolls), Ty,
+                             ytab=None if yidx is not None else ytab,
+                             yidx=yidx))
+            for t in range(T) for d in range(D)]
+
+
+def _dma_fetches(seq):
+    """Descriptor count of BOTH streams for an index sequence: the
+    BlockSpec pipeline re-fetches on every index change (first step
+    included), and the prefetch stream's issue rule — start at step 0,
+    start on lookahead change — is the identical sequence one step
+    early.  One function, asserted equal to stream_plan's ``y``."""
+    fetches = 0
+    last = None
+    for i in seq:
+        if i != last:
+            fetches += 1
+            last = i
+    return fetches
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+@pytest.mark.parametrize("grouped", [False, True])
+def test_plain_grid_matches_model(seed, grouped):
+    rng = np.random.default_rng(seed)
+    T, D = int(rng.integers(2, 9)), int(rng.integers(2, 17))
+    rolls = (rng.integers(0, T, size=D).astype(np.int32) if not grouped
+             else np.repeat(rng.integers(0, T, size=2), -(-D // 2))[:D]
+             .astype(np.int32))
+    plan = stream_plan(rolls, T)
+    seq = _installed_index_seq(rolls, T, T)
+    assert plan["y"] == _dma_fetches(seq)
+    assert plan["y_naive"] == len(seq) == T * D
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fused_table_matches_model(seed):
+    rng = np.random.default_rng(seed + 10)
+    T, D = 6, 8
+    ytab = rng.integers(0, T, size=(D, T)).astype(np.int32)
+    plan = stream_plan(np.zeros(D, np.int32), T, ytab=ytab)
+    seq = _installed_index_seq(np.zeros(D, np.int32), T, T, ytab=ytab)
+    assert plan["y"] == _dma_fetches(seq)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+@pytest.mark.parametrize("fused", [False, True])
+def test_skip_remap_matches_model(seed, fused):
+    """The load-bearing case: frontier skip remaps installed via the
+    REAL skip_tables (cummax pinning, leading steps pinned to step 0's
+    raw index) against stream_plan's active= replay — including the
+    all-dead and leading-dead grids where the pinned step-0 fetch must
+    be charged on both sides."""
+    rng = np.random.default_rng(seed + 20)
+    T, D = int(rng.integers(2, 7)), int(rng.integers(2, 10))
+    Ty = T
+    rolls = rng.integers(0, T, size=D).astype(np.int32)
+    ytab = rng.integers(0, T, size=(D, T)).astype(np.int32) if fused \
+        else None
+    for active in (rng.random(Ty) < 0.5, np.zeros(Ty, bool),
+                   np.ones(Ty, bool)):
+        plan = stream_plan(rolls, T, ytab=ytab, active=active)
+        seq = _installed_index_seq(rolls, T, Ty, ytab=ytab,
+                                   active=jnp.asarray(active))
+        assert plan["y"] == _dma_fetches(seq), (active, seq)
+        assert plan["y_skip"] == int(
+            sum(not active[int(grid_y_index(t, d, rolls, Ty, ytab=ytab))]
+                for t in range(T) for d in range(D)))
+
+
+def test_pull_window_slice_matches_model():
+    rolls = np.array([2, 2, 5, 5, 1, 1], np.int32)
+    plan = stream_plan(rolls, t_blocks=6, n_slots=2)
+    seq = _installed_index_seq(rolls, 6, 6, n_slots=2)
+    assert plan["y"] == _dma_fetches(seq) == 6   # one shared roll
+
+
+def test_prefetch_issue_rule_is_the_dedup_rule():
+    """The kernel's copy-issue discipline (start at step 0, start when
+    the lookahead index differs) issues exactly one copy per fetch the
+    model counts — replayed here with the kernel's literal rule."""
+    rng = np.random.default_rng(7)
+    T, D = 5, 9
+    rolls = rng.integers(0, T, size=D).astype(np.int32)
+    active = rng.random(T) < 0.4
+    seq = _installed_index_seq(rolls, T, T, active=jnp.asarray(active))
+    issues = 0
+    for s in range(len(seq)):
+        cur = seq[s]
+        if s == 0:
+            issues += 1                 # the in-line step-0 copy
+        if s < len(seq) - 1 and seq[s + 1] != cur:
+            issues += 1                 # the lookahead start
+    plan = stream_plan(rolls, T, active=active)
+    assert issues == plan["y"] == _dma_fetches(seq)

@@ -148,8 +148,11 @@ def test_frontier_terms_match_closed_form():
     blk = on.topo.rowblk
     plan0 = stream_plan(np.asarray(on.topo.rolls), T,
                         active=np.zeros(T, bool))
-    assert plan0["y"] == 0 and plan0["y_skip"] == T * D
-    floor = (on.topo.reuse_leak * T * D * W * blk * C * 4
+    # leading steps pin to step 0's raw index, which the pipeline (and
+    # the round-10 prefetch stream) fetch ONCE even when gated — the
+    # replay charges that copy honestly (round-10 drift-guard rule)
+    assert plan0["y"] == 1 and plan0["y_skip"] == T * D
+    floor = ((1 + on.topo.reuse_leak * (T * D - 1)) * W * blk * C * 4
              + D * R * C + R * C + wp)
     t_zero = on.traffic_model(frontier_fill=0.0)
     assert abs(t_zero["push_pass"] - floor) <= TOLERANCE * floor
@@ -172,6 +175,93 @@ def test_frontier_terms_match_closed_form():
                                   n_shards=S)
     w_dense = wide.traffic_model(frontier_fill=1.0, n_shards=S)
     assert w_sparse["delta_gather"] * 2 <= w_dense["delta_gather"]
+
+
+def test_overlap_terms_match_closed_form():
+    """Round-10 overlap terms, pinned on both paths: off keeps the
+    legacy accounting bit-for-bit; on charges the split's honest extra
+    (a second table/gate grid walk + the acc_init round-trip) inside
+    ``total`` and moves the exchange bytes to ``overlap_hidden`` —
+    reported but EXCLUDED from total (the split takes them off the
+    critical path; excluding them only lowers achieved_gb_s and
+    roofline_frac, the conservative direction)."""
+    from p2p_gossipprotocol_tpu.aligned import frontier_capacity
+
+    off = _sim(roll_groups=4, rowblk=64, block_perm=True)
+    on = _sim(roll_groups=4, rowblk=64, block_perm=True, overlap_mode=1)
+    S = 8
+    t_off, t_on = off.traffic_model(n_shards=S), \
+        on.traffic_model(n_shards=S)
+    assert "overlap_extra" not in t_off and "overlap_hidden" not in t_off
+    for k in t_off:
+        if k != "total":
+            assert t_on[k] == t_off[k], k
+    R, C, W = on.topo.rows, 128, on.n_words
+    blk = on.topo.rowblk
+    T = R // blk
+    D = on.topo.n_slots
+    wp = W * R * C * 4
+    assert t_on["overlap_extra"] == T * D * blk * C + T * blk * C + 2 * wp
+    # dense sharded exchange: the hidden bytes are the frontier-plane
+    # gather the model never charged to HBM — reported, not totaled
+    assert t_on["overlap_hidden"] == wp
+    assert t_on["total"] == sum(v for k, v in t_on.items()
+                                if k not in ("total", "overlap_hidden"))
+    # frontier path: the delta_gather bytes MOVE to overlap_hidden
+    fr = _sim(roll_groups=4, rowblk=64, block_perm=True, frontier_mode=1,
+              overlap_mode=1)
+    fr_off = _sim(roll_groups=4, rowblk=64, block_perm=True,
+                  frontier_mode=1)
+    L = W * (R // S) * C
+    K = frontier_capacity(fr.frontier_threshold, L)
+    t_fr = fr.traffic_model(frontier_fill=K / (2 * L), n_shards=S)
+    t_fr_off = fr_off.traffic_model(frontier_fill=K / (2 * L), n_shards=S)
+    assert "delta_gather" not in t_fr
+    assert t_fr["overlap_hidden"] == t_fr_off["delta_gather"] \
+        == S * (2 * K + 1) * 4
+    # solo (n_shards=1) and row-perm overlays never grow the terms
+    assert "overlap_extra" not in on.traffic_model()
+    assert "overlap_extra" not in _sim(
+        roll_groups=4, rowblk=64, overlap_mode=1).traffic_model(
+        n_shards=S)
+
+
+def test_prefetch_leak_is_zero_by_construction():
+    """The manual stream issues no descriptor for a resident re-serve,
+    so its modeled pass bytes equal the leak=0 floor exactly — while
+    the liveness pass (still BlockSpec-pipelined) keeps the calibrated
+    κ charge."""
+    base = _sim(roll_groups=4, churn=ChurnConfig(rate=0.05))
+    pref = _sim(roll_groups=4, churn=ChurnConfig(rate=0.05),
+                prefetch_depth=2)
+    floor = _sim(roll_groups=4, reuse_leak=0.0,
+                 churn=ChurnConfig(rate=0.05))
+    tb, tp, tf = (s.traffic_model() for s in (base, pref, floor))
+    for k in ("push_pass", "pull_pass"):
+        assert tp[k] == tf[k] < tb[k], k
+    assert tp["liveness"] == tb["liveness"]      # pipelined, keeps κ
+
+
+def test_sir_model_round10_terms():
+    """The SIR model's fused-vs-solo accounting (the measure_round10
+    ``sir_fuse_ab`` row reads these numbers): fused deletes the prep
+    stream on a block-perm overlay, adds exactly the riding OR plane,
+    and lands under 1.3 kernel streams."""
+    from p2p_gossipprotocol_tpu.aligned import build_aligned
+    from p2p_gossipprotocol_tpu.aligned_sir import AlignedSIRSimulator
+
+    topo = build_aligned(seed=0, n=1 << 16, n_slots=16,
+                         degree_law="powerlaw", roll_groups=4,
+                         block_perm=True)
+    solo = AlignedSIRSimulator(topo=topo, sir_fuse=0, seed=0)
+    fused = AlignedSIRSimulator(topo=topo, sir_fuse=1, seed=0)
+    ts, tf = solo.traffic_model(), fused.traffic_model()
+    plane = topo.rows * 128 * 4
+    assert ts["prep"] == 3 * plane and tf["prep"] == 0
+    assert tf["count_pass"] == ts["count_pass"] + plane
+    assert tf["total"] <= 1.3 * ts["count_pass"]
+    assert tf["total"] < ts["total"]
+    assert solo.hbm_bytes_per_round() == ts["total"]
 
 
 def test_stream_plan_replays_the_grid():
