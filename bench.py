@@ -48,7 +48,13 @@ GOSSIP_BENCH_PREFETCH (0; -1/2 = auto/force the round-10
 double-buffered DMA stream — bitwise-identical to the pipelined path;
 the A/B lives in benchmarks/measure_round10.py),
 GOSSIP_BENCH_ROOF_GB_S (800, the v5e HBM roof the roofline_frac
-column divides by), GOSSIP_BENCH_FAULTS (a faults.FaultPlan spec, e.g. "drop=0.2"; also
+column divides by), GOSSIP_BENCH_HOSTS (0; > 1 adds the round-11
+per-tier exchange columns — ``ici_gb``/``dcn_gb`` per-chip per-round
+interconnect bytes under a GOSSIP_BENCH_HOSTS x GOSSIP_BENCH_HOST_DEVS
+(default 4) hierarchical factorization, sourced from
+traffic_model()'s ici_gather/dcn_gather terms; the measured flat-vs-
+hier A/B lives in benchmarks/measure_round11.py),
+GOSSIP_BENCH_FAULTS (a faults.FaultPlan spec, e.g. "drop=0.2"; also
 reachable as ``bench.py --faults SPEC``) — the run executes under the
 fault plan and the result line carries a ``faults`` column, so
 BENCH_*.json rows can track fault-plane overhead and
@@ -374,6 +380,32 @@ def _bench_aligned(n, n_msgs, degree, mode):
     total_seen = _pair_int(jax.device_get(_popcount_pair(state.seen_w)))
     n_edges = int(np.asarray(topo.deg).sum())
     bytes_round = sim.hbm_bytes_per_round()
+    # Round-11 per-tier exchange columns: the model's ici/dcn split at
+    # the requested hosts x devs factorization (per chip per round,
+    # dense upper bound — the model never flatters a frontier width it
+    # cannot know).  Sourced from traffic_model() when this run's
+    # frontier path is resolved on; otherwise the same closed form via
+    # project_exchange (traffic_model delegates to it, so the two
+    # cannot drift).  Integer byte fields ride the row so the gb
+    # columns are reproducible from the artifacts alone, the
+    # roofline_frac discipline.
+    hier = {}
+    hosts = _env_int("GOSSIP_BENCH_HOSTS", 0)
+    if hosts > 1:
+        from p2p_gossipprotocol_tpu.aligned import project_exchange
+        hdevs = max(1, _env_int("GOSSIP_BENCH_HOST_DEVS", 4))
+        hier_shards = hosts * hdevs
+        tm_h = sim.traffic_model(n_shards=hier_shards, n_hosts=hosts)
+        if "dcn_gather" not in tm_h:
+            tm_h = project_exchange(
+                n_peers=n, n_msgs=n_msgs, n_shards=hier_shards,
+                n_hosts=hosts, threshold=sim.frontier_threshold,
+                fused=topo.ytab is not None, rows=topo.rows)
+        hier = {"hier_hosts": hosts, "hier_devs": hdevs,
+                "ici_bytes_round": int(tm_h["ici_gather"]),
+                "dcn_bytes_round": int(tm_h["dcn_gather"]),
+                "ici_gb": round(tm_h["ici_gather"] / 1e9, 6),
+                "dcn_gb": round(tm_h["dcn_gather"] / 1e9, 6)}
     # Steady-state per-round rate over a long free-running scan.  The
     # tunneled backend charges a ~70 ms CONSTANT per dispatched loop
     # program (measured: a trivial 6-iteration while_loop costs the
@@ -469,6 +501,7 @@ def _bench_aligned(n, n_msgs, degree, mode):
                           if wall > 0 else None),
         **_roofline(bytes_round, rounds, wall),
         **({"prefetch_depth": prefetch_depth} if prefetch_depth else {}),
+        **hier,
         **steady,
         **fleet,
     }

@@ -1,0 +1,216 @@
+"""Round-11 A/Bs: the hierarchical two-tier exchange.
+
+Rows (one JSON line each; ``parity_ok`` on EVERY row — a byte saving
+with a different trajectory is not a result):
+
+* ``hier_dcn_ab``: the flat frontier exchange vs the two-tier one on
+  the same 8 virtual devices factorized 2 hosts x 4 devices, at the
+  rehearsal scale.  The row reconstructs the per-round INTER-HOST
+  (DCN-tier) gathered bytes of both runs from the regime diagnostics
+  with the closed-form prices (aligned.project_exchange — the same
+  accounting tests/test_traffic_model.py pins): the flat all-gather
+  delivers every remote table to each of the D co-located chips (S-D
+  remote tables per chip cross the host boundary), the hier exchange
+  moves each table once per host pair (H-1 per chip) and re-broadcasts
+  over ICI where bandwidth is nearly free.  Post-peak reduction
+  acceptance >= 2x (expected ~D).  The DCN regime series of the two
+  runs is asserted IDENTICAL (same census, same capacity) and the
+  trajectory bitwise-equal.
+* ``tier_budget_1b``: the 1B-peer per-tier byte budget ROADMAP item 1
+  asks for — aligned.project_exchange at 1B peers x 256 messages over
+  a 64-host x 4-device pod, flat-DCN vs hier-DCN GB/round quoted
+  closed-form (a model row; parity_ok is definitionally true).
+* on TPU, this step also RETRIES the still-pending measure_round10
+  window (ROADMAP item 4: the ``leak_recal`` κ-verification and the
+  overlap trace on silicon) — measure_round10.py resumes per-config
+  from its own landed rows, so the retry is free when they already
+  landed; the outcome is recorded as a ``round10_retry`` row.
+
+Run on the chip (watchdog chain step measure_round11):
+    PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/measure_round11.py
+Appends to GOSSIP_R11_OUT (default benchmarks/results/round11_tpu.jsonl
+on TPU, round11_cpu.jsonl elsewhere), resuming per-config like the
+round-4..10 drivers.  Scale knobs: GOSSIP_R11_PEERS (262144),
+GOSSIP_R11_ROUNDS (20), GOSSIP_R11_HOSTS (2), GOSSIP_R11_DEVS (4).
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+# the A/B needs a multi-device mesh; off-chip that means virtual CPU
+# devices, which must be requested BEFORE jax imports
+_HOSTS = int(os.environ.get("GOSSIP_R11_HOSTS", "2"))
+_DEVS = int(os.environ.get("GOSSIP_R11_DEVS", "4"))
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count="
+                               + str(_HOSTS * _DEVS))
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+OUT = None
+
+
+def _out_path(cpu: bool) -> str:
+    default = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "results",
+        "round11_cpu.jsonl" if cpu else "round11_tpu.jsonl")
+    return os.environ.get("GOSSIP_R11_OUT", default)
+
+
+def emit(row):
+    row["device"] = str(jax.devices()[0]).replace(" ", "_")
+    row["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    print(json.dumps(row), flush=True)
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def _landed() -> set:
+    from benchmarks._common import landed
+    return landed(OUT)
+
+
+def _series_equal(a, b) -> bool:
+    for k in ("coverage", "deliveries", "live_peers", "evictions"):
+        if not np.array_equal(np.asarray(getattr(a, k)),
+                              np.asarray(getattr(b, k))):
+            return False
+    return bool(np.array_equal(
+        np.asarray(jax.device_get(a.state.seen_w)),
+        np.asarray(jax.device_get(b.state.seen_w))))
+
+
+def bench_hier_dcn(n, rounds, hosts, devs, done):
+    """Flat vs two-tier exchange: bitwise trajectory, measured regime
+    series, closed-form per-round DCN bytes both ways."""
+    from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator,
+                                                build_aligned,
+                                                project_exchange)
+    from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+    from p2p_gossipprotocol_tpu.parallel import (AlignedShardedSimulator,
+                                                 make_hier_mesh,
+                                                 make_mesh)
+
+    if "hier_dcn_ab" in done:
+        return
+    shards = hosts * devs
+    if len(jax.devices()) < shards:
+        emit({"config": "hier_dcn_ab", "skipped": True,
+              "reason": f"need {shards} devices, have "
+                        f"{len(jax.devices())}", "parity_ok": True})
+        return
+    n_msgs = int(os.environ.get("GOSSIP_R11_MSGS", "64"))
+    topo = build_aligned(seed=0, n=n, n_slots=16, degree_law="powerlaw",
+                         roll_groups=4, n_msgs=n_msgs, n_shards=shards)
+    kw = dict(topo=topo, n_msgs=n_msgs, mode="pushpull",
+              churn=ChurnConfig(rate=0.05, kill_round=1),
+              max_strikes=3, liveness_every=3, frontier_mode=1, seed=0)
+    flat = AlignedShardedSimulator(mesh=make_mesh(shards), **kw)
+    hier = AlignedShardedSimulator(mesh=make_hier_mesh(hosts, devs),
+                                   hier_mode=1, **kw)
+    r_f = flat.run(rounds, warmup=True)
+    r_h = hier.run(rounds, warmup=True)
+    spar_f = np.asarray(r_f.fr_sparse)
+    spar_h = np.asarray(r_h.fr_sparse)
+    # same census, same capacity -> the DCN regime series must be the
+    # flat regime series bit-for-bit
+    regime_ok = bool(np.array_equal(spar_f, spar_h))
+    inner = hier._inner
+    fused = topo.ytab is not None
+    ex_kw = dict(n_peers=n, n_msgs=n_msgs, n_shards=shards,
+                 n_hosts=hosts, threshold=inner.frontier_threshold,
+                 fused=fused, rows=topo.rows)
+    ex_s = project_exchange(frontier_fill=0.0, **ex_kw)   # sparse round
+    ex_d = project_exchange(frontier_fill=1.0, **ex_kw)   # dense round
+    hier_dcn = np.where(spar_h != 0, ex_s["dcn_gather"],
+                        ex_d["dcn_gather"]).astype(np.int64)
+    flat_dcn = np.where(spar_f != 0, ex_s["flat_dcn"],
+                        ex_d["flat_dcn"]).astype(np.int64)
+    words = np.asarray(r_h.fr_words)
+    peak = int(words.argmax())
+    post = slice(peak + 1, None) if peak + 1 < len(words) else slice(-1,
+                                                                     None)
+    reduction = float(flat_dcn[post].mean()) / float(hier_dcn[post].mean())
+    emit({"config": "hier_dcn_ab", "n_peers": n, "rounds": rounds,
+          "n_msgs": n_msgs, "hosts": hosts, "devs_per_host": devs,
+          "flat_ms_per_round": round(r_f.wall_s / rounds * 1e3, 2),
+          "hier_ms_per_round": round(r_h.wall_s / rounds * 1e3, 2),
+          "speedup": round(r_f.wall_s / r_h.wall_s, 3),
+          "flat_dcn_bytes_round_postpeak": int(flat_dcn[post].mean()),
+          "hier_dcn_bytes_round_postpeak": int(hier_dcn[post].mean()),
+          "dcn_reduction_x": round(reduction, 1),
+          "sparse_rounds": int(spar_h.sum()),
+          "sparse_rounds_ici": int(np.asarray(r_h.fr_sparse_ici).sum()),
+          "capacity_words": int(ex_s["capacity_words"]),
+          "regime_series_ok": regime_ok,
+          "parity_ok": bool(_series_equal(r_f, r_h) and regime_ok)})
+
+
+def bench_tier_budget_1b(done):
+    """The 1B-peer per-tier byte budget (ROADMAP item 1), closed-form:
+    no host can build the topology, but the exchange prices need only
+    shapes (aligned.project_exchange — the same function
+    traffic_model's terms come from)."""
+    from p2p_gossipprotocol_tpu.aligned import project_exchange
+
+    if "tier_budget_1b" in done:
+        return
+    kw = dict(n_peers=1 << 30, n_msgs=256, n_shards=256, n_hosts=64,
+              fused=True)
+    post = project_exchange(frontier_fill=0.001, **kw)   # post-peak
+    peak = project_exchange(frontier_fill=1.0, **kw)     # dense bound
+    emit({"config": "tier_budget_1b", "n_peers": 1 << 30,
+          "n_msgs": 256, "shards": 256, "hosts": 64,
+          "postpeak_dcn_gb_round": round(post["dcn_gather"] / 1e9, 3),
+          "postpeak_ici_gb_round": round(post["ici_gather"] / 1e9, 3),
+          "postpeak_flat_dcn_gb_round": round(post["flat_dcn"] / 1e9, 3),
+          "peak_dcn_gb_round": round(peak["dcn_gather"] / 1e9, 3),
+          "peak_ici_gb_round": round(peak["ici_gather"] / 1e9, 3),
+          "peak_flat_dcn_gb_round": round(peak["flat_dcn"] / 1e9, 3),
+          "postpeak_dcn_reduction_x": round(
+              post["flat_dcn"] / post["dcn_gather"], 1),
+          "parity_ok": True})
+
+
+def retry_round10(on_tpu: bool, done):
+    """ROADMAP item 4's still-pending TPU window: re-invoke
+    measure_round10 (it resumes per-config from its own landed rows —
+    the leak_recal κ verification and the overlap profile are the rows
+    that have never run on silicon).  CPU runs skip: the round-10 CPU
+    rows are committed and a re-run would measure nothing new."""
+    if not on_tpu or "round10_retry" in done:
+        return
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "measure_round10.py")
+    rc = subprocess.run([sys.executable, script]).returncode
+    emit({"config": "round10_retry", "rc": rc, "parity_ok": rc == 0})
+
+
+def main():
+    global OUT
+    backend = jax.default_backend()
+    on_tpu = backend in ("tpu", "axon")
+    OUT = _out_path(cpu=not on_tpu)
+    n = int(os.environ.get("GOSSIP_R11_PEERS", str(1 << 18)))
+    rounds = int(os.environ.get("GOSSIP_R11_ROUNDS", "20"))
+    done = _landed()
+    if "_backend" not in done:
+        emit({"config": "_backend", "backend": backend, "n_peers": n,
+              "rounds": rounds, "parity_ok": True})
+    bench_hier_dcn(n, rounds, _HOSTS, _DEVS, done)
+    bench_tier_budget_1b(done)
+    retry_round10(on_tpu, done)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,6 +14,7 @@ transport.
     python benchmarks/multihost_rehearsal.py            # driver
     python benchmarks/multihost_rehearsal.py --rounds 8
     python benchmarks/multihost_rehearsal.py --supervise   # self-healing
+    python benchmarks/multihost_rehearsal.py --hier --supervise
 
 Writes benchmarks/results/multihost_rehearsal.json and exits 0 iff both
 workers ran the distributed job and gossip converged.
@@ -27,6 +28,17 @@ its multi-host step to.  Where this jax build cannot run multi-process
 CPU collectives at all, the supervisor's spmd=auto falls back to the
 single-process-spmd (chief) rehearsal and records which mode ran
 (benchmarks/results/multihost_supervised.json).
+
+``--hier`` (round 11) rehearses the TWO-TIER exchange end-to-end: the
+mesh factorizes as processes x devices (``make_hier_mesh`` — the real
+process boundary IS the host axis, so the DCN tier of the exchange
+really crosses it), the frontier delta exchange is forced on, and the
+two-tier routing is forced on (hier_mode=1 — auto would resolve off
+under CPU interpret).  Composes with ``--supervise``: the supervised
+worker builds the hier survivor mesh, and a shrink re-derives the
+survivor-host factorization (parallel.mesh.make_survivor_mesh hier=).
+Artifacts land in multihost_hier.json / multihost_supervised.json (the
+latter records hier in its config block).
 """
 from __future__ import annotations
 
@@ -44,8 +56,15 @@ if REPO not in sys.path:      # worker/supervised modes import the pkg
     sys.path.insert(0, REPO)
 OUT = os.path.join(REPO, "benchmarks", "results",
                    "multihost_rehearsal.json")
+OUT_HIER = os.path.join(REPO, "benchmarks", "results",
+                        "multihost_hier.json")
 OUT_SUPERVISED = os.path.join(REPO, "benchmarks", "results",
                               "multihost_supervised.json")
+# --hier --supervise writes its own artifact: the plain supervised
+# rehearsal's recorded run must not be clobbered by the hier variant
+# (they rehearse different exchange paths; both deserve a green record)
+OUT_HIER_SUPERVISED = os.path.join(REPO, "benchmarks", "results",
+                                   "multihost_hier_supervised.json")
 DEVS_PER_PROC = 4
 N_PROCS = 2
 
@@ -72,7 +91,7 @@ CONFIG = {
 
 
 def worker(process_id: int, port: int, rounds: int,
-           heartbeat_file: str | None = None) -> int:
+           heartbeat_file: str | None = None, hier: bool = False) -> int:
     # init stamp BEFORE jax: backend/rendezvous init is the canonical
     # place to hang, and the supervision plane must see the process
     # came up (runtime/supervisor.py heartbeat protocol)
@@ -94,6 +113,7 @@ def worker(process_id: int, port: int, rounds: int,
     from p2p_gossipprotocol_tpu.aligned import build_aligned
     from p2p_gossipprotocol_tpu.liveness import ChurnConfig
     from p2p_gossipprotocol_tpu.parallel import (AlignedShardedSimulator,
+                                                 make_hier_mesh,
                                                  make_mesh)
 
     # the SAME host-side construction on every process (deterministic in
@@ -101,17 +121,24 @@ def worker(process_id: int, port: int, rounds: int,
     # round-5 kernel features ride along (roll_groups so pull_window is
     # admissible, fuse_update for the in-kernel seen-update): the fused
     # paths must execute across a REAL process boundary, not just the
-    # single-process mesh the unit tests use.
+    # single-process mesh the unit tests use.  With ``hier`` the mesh
+    # factorizes processes x devices — the DCN tier of the two-tier
+    # frontier exchange then crosses the REAL process boundary — and
+    # both the delta exchange and the two-tier routing are forced on
+    # (auto would resolve them off under CPU interpret).
     topo = build_aligned(seed=5, n=CONFIG["n_peers"], n_slots=6,
                          rowblk=1, n_shards=n_global,
                          roll_groups=CONFIG["roll_groups"])
+    mesh = (make_hier_mesh(N_PROCS, DEVS_PER_PROC) if hier
+            else make_mesh(n_global))
+    hier_kw = dict(hier_mode=1, frontier_mode=1) if hier else {}
     sim = AlignedShardedSimulator(
-        topo=topo, mesh=make_mesh(n_global), n_msgs=CONFIG["n_msgs"],
+        topo=topo, mesh=mesh, n_msgs=CONFIG["n_msgs"],
         mode=CONFIG["mode"],
         churn=ChurnConfig(rate=CONFIG["churn_rate"], kill_round=1),
         max_strikes=2, message_stagger=CONFIG["message_stagger"],
         pull_window=CONFIG["pull_window"],
-        fuse_update=CONFIG["fuse_update"], seed=3)
+        fuse_update=CONFIG["fuse_update"], **hier_kw, seed=3)
     if heartbeat_file:
         # chunked run with a round-stamped heartbeat after each chunk
         # — the supervised mode of this worker; the rebuilt result is
@@ -141,6 +168,22 @@ def worker(process_id: int, port: int, rounds: int,
         "live_peers": int(res.live_peers[-1]),
         "wall_s": round(float(res.wall_s), 3),
     }
+    if hier:
+        # the two-tier diagnostics + the model's per-tier byte split —
+        # what the artifact quotes as "measured per-tier" evidence.
+        # (run_chunked rebuilds results from dataclass fields, so the
+        # attached fr_* diagnostics exist only on the monolithic path.)
+        tm = sim._inner.traffic_model(n_shards=n_global,
+                                      n_hosts=N_PROCS)
+        fr_s = getattr(res, "fr_sparse", None)
+        fr_i = getattr(res, "fr_sparse_ici", None)
+        line.update(
+            hier=True,
+            sparse_rounds=None if fr_s is None else int(fr_s.sum()),
+            sparse_rounds_ici=(None if fr_i is None
+                               else int(fr_i.sum())),
+            ici_bytes_round=int(tm["ici_gather"]),
+            dcn_bytes_round=int(tm["dcn_gather"]))
     print("WORKER_RESULT " + json.dumps(line), flush=True)
     jax.distributed.shutdown()
     return 0
@@ -168,7 +211,7 @@ def _reap(procs: list) -> None:
                 pass
 
 
-def _attempt(rounds: int) -> tuple[list, list]:
+def _attempt(rounds: int, hier: bool = False) -> tuple[list, list]:
     with socket.socket() as s:     # free coordinator port (best effort;
         s.bind(("127.0.0.1", 0))   # bind-then-close races are retried
         port = s.getsockname()[1]  # by the caller)
@@ -183,7 +226,8 @@ def _attempt(rounds: int) -> tuple[list, list]:
     procs = [
         subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--worker",
-             str(i), "--port", str(port), "--rounds", str(rounds)],
+             str(i), "--port", str(port), "--rounds", str(rounds)]
+            + (["--hier"] if hier else []),
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True, start_new_session=True)
         for i in range(N_PROCS)
@@ -220,7 +264,7 @@ def _is_bind_race(errors: list) -> bool:
                for e in errors)
 
 
-def driver(rounds: int) -> int:
+def driver(rounds: int, hier: bool = False) -> int:
     # The ephemeral coordinator port can be stolen between probe and
     # jax.distributed.initialize; a failed rendezvous is retried on a
     # fresh port instead of burning the caller's whole timeout.  A
@@ -230,7 +274,7 @@ def driver(rounds: int) -> int:
     # IS then worth reporting.
     attempt = bind_races = 0
     while True:
-        results, errors = _attempt(rounds)
+        results, errors = _attempt(rounds, hier=hier)
         if not errors:
             break
         if _is_bind_race(errors):
@@ -274,18 +318,21 @@ def driver(rounds: int) -> int:
         "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "config": {**CONFIG, "rounds": rounds,
                    "n_processes": N_PROCS,
-                   "devices_per_process": DEVS_PER_PROC},
+                   "devices_per_process": DEVS_PER_PROC,
+                   **({"hier": True, "hier_hosts": N_PROCS,
+                       "hier_devs": DEVS_PER_PROC} if hier else {})},
         "workers": results,
         "errors": errors,
     }
-    os.makedirs(os.path.dirname(OUT), exist_ok=True)
-    with open(OUT, "w") as f:
+    out = OUT_HIER if hier else OUT
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
         json.dump(artifact, f, indent=1)
     print(json.dumps(artifact))
     return 0 if ok else 1
 
 
-def supervised_driver(rounds: int) -> int:
+def supervised_driver(rounds: int, hier: bool = False) -> int:
     """The rehearsal under the runtime supervisor: same scenario,
     expressed as a config file and executed by
     ``p2p_gossipprotocol_tpu.runtime.worker`` processes under the
@@ -315,7 +362,10 @@ def supervised_driver(rounds: int) -> int:
                  "supervise=1\n"
                  f"supervise_workers={N_PROCS}\n"
                  f"supervise_devs_per_proc={DEVS_PER_PROC}\n"
-                 "supervise_spmd=auto\n")
+                 "supervise_spmd=auto\n"
+                 + (f"hier_hosts={N_PROCS}\n"
+                    f"hier_devs={DEVS_PER_PROC}\n"
+                    "hier_mode=1\nfrontier_mode=1\n" if hier else ""))
     cfg = NetworkConfig(cfg_path)
     res = supervise_from_config(
         cfg, config_path=cfg_path, rounds=rounds,
@@ -324,10 +374,14 @@ def supervised_driver(rounds: int) -> int:
                 "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
                 "config": {**CONFIG, "rounds": rounds,
                            "n_processes": N_PROCS,
-                           "devices_per_process": DEVS_PER_PROC},
+                           "devices_per_process": DEVS_PER_PROC,
+                           **({"hier": True, "hier_hosts": N_PROCS,
+                               "hier_devs": DEVS_PER_PROC}
+                              if hier else {})},
                 **res.summary()}
-    os.makedirs(os.path.dirname(OUT_SUPERVISED), exist_ok=True)
-    with open(OUT_SUPERVISED, "w") as f:
+    out = OUT_HIER_SUPERVISED if hier else OUT_SUPERVISED
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
         json.dump(artifact, f, indent=1)
     print(json.dumps(artifact))
     if res.skipped:
@@ -350,13 +404,19 @@ def main() -> int:
                     help="driver mode: run the rehearsal under the "
                          "runtime supervisor (self-healing; "
                          "spmd=auto with recorded fallback)")
+    ap.add_argument("--hier", action="store_true",
+                    help="rehearse the round-11 two-tier exchange: "
+                         "processes x devices hierarchical mesh, "
+                         "frontier delta exchange + two-tier routing "
+                         "forced on (composes with --supervise)")
     args = ap.parse_args()
     if args.worker is not None:
         return worker(args.worker, args.port, args.rounds,
-                      heartbeat_file=args.heartbeat_file)
+                      heartbeat_file=args.heartbeat_file,
+                      hier=args.hier)
     if args.supervise:
-        return supervised_driver(args.rounds)
-    return driver(args.rounds)
+        return supervised_driver(args.rounds, hier=args.hier)
+    return driver(args.rounds, hier=args.hier)
 
 
 if __name__ == "__main__":

@@ -109,7 +109,7 @@ record_fail() {
   fi
 }
 
-STEP_NAMES="bench mosaic_smoke measure_round4 measure_round5 measure_round6 measure_round7 measure_round8 measure_round9 measure_round10 baselines multihost longrun"
+STEP_NAMES="bench mosaic_smoke measure_round4 measure_round5 measure_round6 measure_round7 measure_round8 measure_round9 measure_round10 measure_round11 baselines multihost longrun"
 # Headline first: a short tunnel window must yield the most important
 # artifact.  bench keeps its file contract (ONE parsed line) and only
 # stamps when the line really came from the chip.  longrun is the
@@ -135,6 +135,11 @@ PY" ;;
     measure_round8) echo "python benchmarks/measure_round8.py" ;;
     measure_round9) echo "python benchmarks/measure_round9.py" ;;
     measure_round10) echo "python benchmarks/measure_round10.py" ;;
+    # round-11 A/B (flat vs two-tier DCN bytes) — on TPU the same step
+    # also retries the still-pending measure_round10 rows (leak_recal
+    # on silicon + the overlap trace; ROADMAP item 4), since
+    # measure_round10.py resumes per-config from its landed rows
+    measure_round11) echo "python benchmarks/measure_round11.py" ;;
     baselines)      echo "python benchmarks/run_baselines.py" ;;
     multihost)
       # the multi-host step is DELEGATED to the runtime supervisor
@@ -167,6 +172,7 @@ step_tmo() {
     measure_round8) echo 3600 ;;
     measure_round9) echo 3600 ;;
     measure_round10) echo 3600 ;;
+    measure_round11) echo 3600 ;;
     baselines) echo 4800 ;;
     multihost) echo 1800 ;;
     longrun) echo 1800 ;;

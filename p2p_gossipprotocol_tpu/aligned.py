@@ -436,11 +436,20 @@ class FrontierCarry:
     ``byz_g`` (row-perm overlays only): the GATHERED byzantine words —
     the byzantine draw is static for a run, so the frontier path hoists
     its per-round plane gather to ONE gather at carry init; the fused
-    path masks through ``src_ok`` and carries None."""
+    path masks through ``src_ok`` and carries None.
+
+    ``regime_ici`` (hierarchical meshes only, round 11): the ICI
+    (intra-host) tier's own dense/sparse flag — each tier of the
+    two-tier exchange reads its own census and switches independently
+    (``regime`` is then the DCN tier's flag, driven by the SAME
+    per-device census and capacity as the flat exchange, so the DCN
+    regime trajectory is bitwise the flat one's).  Derived state like
+    the rest of the carry; None on flat meshes."""
 
     replica_w: jax.Array | None
     byz_g: jax.Array | None
     regime: jax.Array              # int32 scalar
+    regime_ici: jax.Array | None = None
 
 
 def frontier_capacity(threshold: float, local_words: int) -> int:
@@ -452,8 +461,158 @@ def frontier_capacity(threshold: float, local_words: int) -> int:
                                           -(-k // 128) * 128))
 
 
+def resolve_hier(hier_hosts: int, hier_devs: int, peer_shards: int,
+                 clamps: list[str] | None = None) -> tuple[int, int]:
+    """Resolve a configured ``hier_hosts x hier_devs`` factorization
+    against the actual peer-shard count — the one rule every surface
+    shares (from_config for the solo/fleet statics, build_simulator
+    for each sharded mesh).  Illegal combinations DEGRADE to the flat
+    mesh with a recorded clamp (the PR 2 illegal-combo precedent),
+    never a crash: the hierarchy changes routing only, so flat is
+    always a correct fallback.  Returns ``(hosts, devs)`` — ``(0, 0)``
+    for flat."""
+    hh, hd = hier_hosts, hier_devs
+    if hh <= 1:
+        if hd and clamps is not None and hh == 0:
+            clamps.append(
+                f"hier_devs {hd} without hier_hosts -> flat mesh "
+                "(the factorization needs both tiers)")
+        return 0, 0
+    if peer_shards <= 1:
+        if clamps is not None:
+            clamps.append(
+                f"hier_hosts {hh} on a single-device run -> flat "
+                "(the hierarchy factorizes a sharded peer axis)")
+        return 0, 0
+    if hd == 0:
+        hd = peer_shards // hh if peer_shards % hh == 0 else 0
+    if hh * hd != peer_shards:
+        if clamps is not None:
+            clamps.append(
+                f"hier_hosts x hier_devs {hier_hosts}x{hier_devs} "
+                f"does not factorize the {peer_shards}-shard peer "
+                "axis -> flat mesh")
+        return 0, 0
+    return hh, hd
+
+
+def project_exchange(n_peers: int, n_msgs: int, n_shards: int,
+                     n_hosts: int = 0, frontier_fill: float = 1.0,
+                     threshold: float = FRONTIER_THRESHOLD_DEFAULT,
+                     fused: bool = False,
+                     rows: int | None = None) -> dict:
+    """Closed-form per-chip interconnect bytes of one round's frontier
+    exchange — NO topology needed, so it projects scales no host can
+    build (the 1B-peer per-tier byte budget ROADMAP item 1 asks for).
+    ``traffic_model`` prices its exchange terms through this function,
+    so the model and the projector cannot drift.
+
+    Flat (``n_hosts`` <= 1): everything rides the fast tier —
+    ``delta_gather`` is the pre-hierarchy model bit-for-bit (the
+    compacted ``(index, word)`` tables below capacity, the dense W
+    frontier planes above, plus the alive mask plane on the non-fused
+    path) and ``dcn_gather == 0``.
+
+    Hierarchical: the DCN tier moves each device's table/slice once
+    per REMOTE HOST (``H-1`` tables of the flat per-device capacity —
+    same census, same K), and the ICI tier assembles the ``D`` column
+    slices within the host (``D-1`` column tables under the ICI
+    capacity, or the dense column planes).  ``flat_dcn`` is what the
+    FLAT exchange pushes across the host boundary per chip on the
+    same physical layout — ``S-D`` remote tables, the D-fold
+    redundant delivery the hierarchy deletes — so
+    ``flat_dcn / dcn_gather`` is the round-11 A/B's headline ratio
+    (~D post-peak)."""
+    C = LANES
+    R = rows if rows is not None else -(-n_peers // C)
+    W = n_msg_words(n_msgs)
+    L = W * (R // n_shards) * C          # packed words per device
+    K = frontier_capacity(threshold, L)
+    fill = min(max(frontier_fill, 0.0), 1.0)
+    changed = int(fill * L)
+    sparse = changed <= K
+    sl = (R // n_shards) * C * 4         # one device's mask-plane slice
+    wp, plane = W * R * C * 4, R * C * 4
+    hier = (n_hosts and n_hosts > 1 and n_shards % n_hosts == 0
+            and n_hosts < n_shards)
+    if not hier:
+        ici = n_shards * (2 * K + 1) * 4 if sparse else wp
+        if not fused:
+            ici += plane
+        return {"delta_gather": ici, "ici_gather": ici,
+                "dcn_gather": 0, "flat_dcn": 0, "capacity_words": K}
+    D = n_shards // n_hosts
+    Kc = frontier_capacity(threshold, L * n_hosts)   # ICI column table
+    sparse_i = changed * n_hosts <= Kc
+    dcn = ((n_hosts - 1) * (2 * K + 1) * 4 if sparse
+           else (n_hosts - 1) * L * 4)
+    ici = ((D - 1) * (2 * Kc + 1) * 4 if sparse_i
+           else (D - 1) * n_hosts * L * 4)
+    flat_dcn = ((n_shards - D) * (2 * K + 1) * 4 if sparse
+                else (n_shards - D) * L * 4)
+    if not fused:
+        # the alive mask plane, staged like every hier gather: one
+        # slice per remote host over DCN, the column re-broadcast
+        # over ICI (flat: one slice per remote chip crosses DCN)
+        dcn += (n_hosts - 1) * sl
+        ici += (D - 1) * n_hosts * sl
+        flat_dcn += (n_shards - D) * sl
+    return {"delta_gather": dcn + ici, "ici_gather": ici,
+            "dcn_gather": dcn, "flat_dcn": flat_dcn,
+            "capacity_words": K, "capacity_words_ici": Kc}
+
+
+def _sparse_gather(planes: jax.Array, changed: jax.Array,
+                   n_changed: jax.Array, axis, K: int, gidx: jax.Array,
+                   out_words: int):
+    """One tier's scatter-compacted exchange: compact this member's
+    changed words into a static ``K``-word ``(index, word)`` table,
+    all-gather the tables over ``axis``, scatter-ADD into zeros of
+    ``out_words`` int32.  Exact: deltas are bit-disjoint from zeros and
+    every output word has exactly one owner member (``gidx`` is a
+    member-disjoint map into the output space); changed word j lands at
+    slot pos[j] (< K on the caller's cond branch — its predicate
+    guarantees the fit); unchanged words ADD zero at slot 0, which no
+    real word can lose to; invalid gathered slots add 0."""
+    flat = planes.reshape(-1)
+    pos = jnp.cumsum(changed, dtype=jnp.int32) - 1
+    tgt = jnp.where(changed, jnp.minimum(pos, K - 1), 0)
+    vals = jnp.zeros(K, jnp.int32).at[tgt].add(
+        jnp.where(changed, flat, 0))
+    idxs = jnp.zeros(K, jnp.int32).at[tgt].add(
+        jnp.where(changed, gidx, 0))
+    idx_g = jax.lax.all_gather(idxs, axis)          # [M, K]
+    val_g = jax.lax.all_gather(vals, axis)          # [M, K]
+    cnt_g = jax.lax.all_gather(n_changed, axis)     # [M]
+    valid = jnp.arange(K, dtype=jnp.int32)[None, :] < cnt_g[:, None]
+    return jnp.zeros(out_words, jnp.int32).at[
+        jnp.where(valid, idx_g, 0).reshape(-1)].add(
+        jnp.where(valid, val_g, 0).reshape(-1))
+
+
+def _hier_gather(x: jax.Array, dcn_axis: str, ici_axis: str,
+                 n_hosts: int, n_devs: int) -> jax.Array:
+    """``all_gather`` of the rows axis (ndim-2), staged over the
+    hierarchy: gather this device's row slice across HOSTS first (the
+    DCN tier moves each slice once per host pair instead of once per
+    remote CHIP — the flat all-gather's D-fold redundant inter-host
+    delivery is the round-11 win), then assemble across the intra-host
+    ICI tier and reshuffle the ``(d, h)``-ordered blocks into global
+    ``(h, d)`` row order.  Pure data movement — bitwise the flat
+    gather."""
+    r_ax = x.ndim - 2
+    rl, c = x.shape[r_ax], x.shape[-1]
+    g1 = jax.lax.all_gather(x, dcn_axis, axis=r_ax, tiled=True)
+    g2 = jax.lax.all_gather(g1, ici_axis)       # [D, ..., H*rl, c]
+    pre = tuple(g2.shape[1:r_ax + 1])
+    g2 = g2.reshape((n_devs,) + pre + (n_hosts, rl, c))
+    g2 = jnp.moveaxis(g2, 0, -3)                # [..., H, D, rl, c]
+    return g2.reshape(pre + (n_hosts * n_devs * rl, c))
+
+
 def _frontier_exchange(sim, frontier_l: jax.Array, fr: FrontierCarry,
-                       axis: str, pmax_axes, n_shards: int):
+                       axis, pmax_axes, n_shards: int,
+                       ici_axis: str | None = None, n_hosts: int = 1):
     """One round's cross-chip exchange on the frontier-sparse path —
     the drop-in replacement for the dense ``all_gather`` of the send
     planes, exact by seen-set monotonicity.
@@ -481,55 +640,124 @@ def _frontier_exchange(sim, frontier_l: jax.Array, fr: FrontierCarry,
     changed words on the WORST shard, leave only past K (where the
     compaction no longer fits and dense is forced anyway) — so the
     choice lives inside the compiled scan with no host sync.
+    ``axis`` may be a tuple of mesh axes (a hierarchical mesh running
+    the FLAT exchange — hier_mode resolved off): the gathers and the
+    member index generalize unchanged.
 
-    Returns ``(F_global, fr', went_sparse, worst_words)``."""
+    HIERARCHICAL path (``ici_axis`` set, round 11): the exchange runs
+    per TIER.  Tier 1 (DCN, ``axis`` = the host axis): each device
+    exchanges its OWN row slice with its column group across hosts —
+    dense tiled gather or the compacted table above, with the SAME
+    per-device census and capacity as the flat exchange (so
+    ``fr.regime`` and the fr_sparse diagnostic stay bitwise the flat
+    trajectory) — yielding this column's host-major slice of the
+    global frontier.  Crucially each slice crosses the inter-host tier
+    ONCE per host pair; the flat all-gather delivers every remote
+    table to each of the D co-located chips independently, a D-fold
+    redundancy on exactly the links where gathered bytes hurt.  Tier 2
+    (ICI, ``ici_axis``): the D column slices assemble into the global
+    frontier within the host — dense stacked gather + static reshuffle
+    into global row order, or the same compacted exchange on the
+    column table under the ICI tier's OWN census/capacity/hysteresis
+    (``fr.regime_ici``) scattering straight into global order.  Every
+    regime combination is bitwise the flat gather (tests/test_hier.py).
+
+    Returns ``(F_global, fr', went_sparse, worst_words, went_ici)``
+    (``went_ici`` None on the flat path)."""
     W_l, Rl, C = frontier_l.shape
     Rg = Rl * n_shards
     L = W_l * Rl * C
     K = frontier_capacity(sim.frontier_threshold, L)
-    grow0 = jax.lax.axis_index(axis) * Rl
     changed = (frontier_l != 0).reshape(-1)
     n_changed = jnp.sum(changed, dtype=jnp.int32)
     worst = n_changed
     for ax in pmax_axes:
         worst = jax.lax.pmax(worst, ax)
+    i = jnp.arange(L, dtype=jnp.int32)
 
-    def dense(_):
+    if ici_axis is None:
+        grow0 = jax.lax.axis_index(axis) * Rl
+
+        def dense(_):
+            return jax.lax.all_gather(frontier_l, axis, axis=1,
+                                      tiled=True)
+
+        def sparse(_):
+            # global word id of local word i: plane-major, global rows
+            g_i = (i // (Rl * C)) * (Rg * C) + grow0 * C + i % (Rl * C)
+            return _sparse_gather(frontier_l, changed, n_changed, axis,
+                                  K, g_i, W_l * Rg * C
+                                  ).reshape(W_l, Rg, C)
+
+        went_sparse = (fr.regime == 1) & (worst <= K)
+        F = jax.lax.cond(went_sparse, sparse, dense, None)
+        regime2 = jnp.where(fr.regime == 1, worst <= K,
+                            worst <= K // 2).astype(jnp.int32)
+        replica2 = None if fr.replica_w is None else fr.replica_w | F
+        return (F, FrontierCarry(replica_w=replica2, byz_g=fr.byz_g,
+                                 regime=regime2),
+                went_sparse.astype(jnp.int32), worst, None)
+
+    # ---- hierarchical two-tier exchange -----------------------------
+    D = n_shards // n_hosts
+    Rc = n_hosts * Rl               # this column's rows (host-major)
+    Lc = W_l * Rc * C
+    K_i = frontier_capacity(sim.frontier_threshold, Lc)
+    h = jax.lax.axis_index(axis)
+    d = jax.lax.axis_index(ici_axis)
+    # ICI-tier census: this COLUMN's total changed words (its table is
+    # the union of one slice per host), made uniform across the mesh
+    # like ``worst`` so every device takes the same cond branch
+    col = jax.lax.psum(n_changed, axis)
+    worst_col = col
+    for ax in pmax_axes:
+        worst_col = jax.lax.pmax(worst_col, ax)
+
+    def dcn_dense(_):
         return jax.lax.all_gather(frontier_l, axis, axis=1, tiled=True)
 
-    def sparse(_):
-        flat = frontier_l.reshape(-1)
-        pos = jnp.cumsum(changed, dtype=jnp.int32) - 1
-        i = jnp.arange(L, dtype=jnp.int32)
-        # global word id of local word i: plane-major over global rows
-        g_i = (i // (Rl * C)) * (Rg * C) + grow0 * C + i % (Rl * C)
-        # compaction: changed word j lands at slot pos[j] (< K on this
-        # branch — the cond predicate guarantees the fit); unchanged
-        # words ADD zero at slot 0, which no real word can lose to
-        tgt = jnp.where(changed, jnp.minimum(pos, K - 1), 0)
-        vals = jnp.zeros(K, jnp.int32).at[tgt].add(
-            jnp.where(changed, flat, 0))
-        idxs = jnp.zeros(K, jnp.int32).at[tgt].add(
-            jnp.where(changed, g_i, 0))
-        idx_g = jax.lax.all_gather(idxs, axis)          # [S, K]
-        val_g = jax.lax.all_gather(vals, axis)          # [S, K]
-        cnt_g = jax.lax.all_gather(n_changed, axis)     # [S]
-        valid = jnp.arange(K, dtype=jnp.int32)[None, :] < cnt_g[:, None]
-        # scatter-ADD == scatter-OR here: targets are zero and each
-        # global word has exactly one owner shard; invalid slots add 0
-        F = jnp.zeros(W_l * Rg * C, jnp.int32).at[
-            jnp.where(valid, idx_g, 0).reshape(-1)].add(
-            jnp.where(valid, val_g, 0).reshape(-1))
-        return F.reshape(W_l, Rg, C)
+    def dcn_sparse(_):
+        # word id inside the COLUMN table [W_l, H*Rl, C], host-major
+        g_i = (i // (Rl * C)) * (Rc * C) + h * Rl * C + i % (Rl * C)
+        return _sparse_gather(frontier_l, changed, n_changed, axis,
+                              K, g_i, W_l * Rc * C).reshape(W_l, Rc, C)
 
-    went_sparse = (fr.regime == 1) & (worst <= K)
-    F = jax.lax.cond(went_sparse, sparse, dense, None)
+    went_dcn = (fr.regime == 1) & (worst <= K)
+    F_col = jax.lax.cond(went_dcn, dcn_sparse, dcn_dense, None)
     regime2 = jnp.where(fr.regime == 1, worst <= K,
                         worst <= K // 2).astype(jnp.int32)
+
+    changed_c = (F_col != 0).reshape(-1)
+    n_changed_c = jnp.sum(changed_c, dtype=jnp.int32)
+
+    def ici_dense(_):
+        g2 = jax.lax.all_gather(F_col, ici_axis)   # [D, W_l, H*Rl, C]
+        g2 = g2.reshape(D, W_l, n_hosts, Rl, C)
+        # (d, h)-ordered blocks -> global (h, d) row order
+        return jnp.transpose(g2, (1, 2, 0, 3, 4)).reshape(W_l, Rg, C)
+
+    def ici_sparse(_):
+        # word id in the GLOBAL planes: column word (w, h*Rl + r, c)
+        # lives at global row (h*D + d)*Rl + r
+        j = jnp.arange(Lc, dtype=jnp.int32)
+        w = j // (Rc * C)
+        rem = j % (Rc * C)
+        r_col, c = rem // C, rem % C
+        hh, r = r_col // Rl, r_col % Rl
+        g_j = w * (Rg * C) + ((hh * D + d) * Rl + r) * C + c
+        return _sparse_gather(F_col, changed_c, n_changed_c, ici_axis,
+                              K_i, g_j, W_l * Rg * C
+                              ).reshape(W_l, Rg, C)
+
+    went_ici = (fr.regime_ici == 1) & (worst_col <= K_i)
+    F = jax.lax.cond(went_ici, ici_sparse, ici_dense, None)
+    regime_i2 = jnp.where(fr.regime_ici == 1, worst_col <= K_i,
+                          worst_col <= K_i // 2).astype(jnp.int32)
     replica2 = None if fr.replica_w is None else fr.replica_w | F
     return (F, FrontierCarry(replica_w=replica2, byz_g=fr.byz_g,
-                             regime=regime2),
-            went_sparse.astype(jnp.int32), worst)
+                             regime=regime2, regime_ici=regime_i2),
+            went_dcn.astype(jnp.int32), worst,
+            went_ici.astype(jnp.int32))
 
 
 def _skip_plan(y: jax.Array, rowblk: int, t_local: int,
@@ -784,6 +1012,20 @@ class AlignedSimulator:
     #: contributes in exactly one of the two passes (complementary
     #: yact gates) and OR is associative.
     overlap_mode: int = 0
+    #: two-tier hierarchical exchange (round 11): the resolved
+    #: ``hosts x devs_per_host`` factorization of the peer mesh this
+    #: scenario targets (0 = flat).  The solo engine never exchanges —
+    #: these are RESOLVED STATICS carried for the sharded engines
+    #: (which derive them from their mesh and thread them here) and
+    #: for the fleet packer's bucket signature; ``hier_mode`` follows
+    #: the frontier_mode auto rule: -1 = on for the compiled path /
+    #: off under interpret, 0/1 force.  Routing only — bitwise-
+    #: identical to the flat exchange (tests/test_hier.py) — so all
+    #: three are excluded from checkpoint fingerprints like
+    #: frontier_mode before them.
+    hier_hosts: int = 0
+    hier_devs: int = 0
+    hier_mode: int = -1
     seed: int = 0
     interpret: bool | None = None   # None -> interpret unless on TPU
 
@@ -926,6 +1168,19 @@ class AlignedSimulator:
                               and not self.interpret))
                          and self.topo.ytab is not None
                          and self.mode in ("push", "pushpull"))
+        # Hierarchical two-tier exchange (round 11): resolved here so
+        # the fleet packer and the traffic model read one static; the
+        # sharded engines additionally require their mesh to carry the
+        # factorization.  Auto keys off interpret like frontier_mode
+        # (the staged exchange only adds XLA work on the CPU path).
+        if self.hier_mode not in (-1, 0, 1):
+            raise ValueError("hier_mode must be -1 (auto), 0, or 1")
+        if self.hier_hosts < 0 or self.hier_devs < 0:
+            raise ValueError("hier_hosts/hier_devs must be >= 0")
+        self._hier = (self.hier_hosts > 1
+                      and (self.hier_mode == 1
+                           or (self.hier_mode == -1
+                               and not self.interpret)))
         # Liveness (strikes/rewire) runs whenever peers can die — without
         # churn no neighbor is ever observed dead, so the pass is skipped
         # statically and the strike plane is never allocated.
@@ -1054,6 +1309,13 @@ class AlignedSimulator:
                     "overlap_mode 1 on a row-perm overlay -> 0 "
                     "(the self/remote split needs the block-perm "
                     "overlay's block-granular locality)")
+        # Hierarchical two-tier exchange (round 11): resolve the
+        # configured hosts x devs factorization against THIS build's
+        # peer-shard count — illegal combinations degrade to flat with
+        # a recorded clamp (resolve_hier; the 2-D engine re-resolves
+        # against its peer sub-axis in engines.build_simulator).
+        hier_hosts, hier_devs = resolve_hier(
+            cfg.hier_hosts, cfg.hier_devs, n_shards, clamps)
         # n_msgs sizes the kernel's VMEM row block: wide message sets
         # shrink it (W * rowblk <= budget), and NARROW ones now widen it
         # up to MAX_CONFIG_ROWBLK — fewer grid steps and longer DMA
@@ -1100,11 +1362,14 @@ class AlignedSimulator:
                    frontier_threshold=cfg.frontier_threshold,
                    prefetch_depth=cfg.prefetch_depth,
                    overlap_mode=cfg.overlap_mode,
+                   hier_hosts=hier_hosts, hier_devs=hier_devs,
+                   hier_mode=cfg.hier_mode,
                    seed=cfg.prng_seed)
 
     # ------------------------------------------------------------------
     def traffic_model(self, frontier_fill: float | None = None,
-                      n_shards: int = 1) -> dict:
+                      n_shards: int = 1,
+                      n_hosts: int | None = None) -> dict:
         """Per-term analytic HBM model for one average round — the
         denominator behind the bench line's ``achieved_gb_s`` (measured
         wall-clock per round vs bytes this model says the round moves,
@@ -1123,6 +1388,18 @@ class AlignedSimulator:
         tables when the changed words fit the capacity, the dense W
         frontier planes otherwise, plus the two per-peer mask planes
         the non-fused path gathers post-exchange.
+
+        Per-TIER terms (round 11): whenever the exchange exists, the
+        model also reports its ``ici_gather``/``dcn_gather`` split —
+        per-chip fast-tier vs slow-tier interconnect bytes under the
+        ``n_hosts`` factorization (None = this sim's resolved
+        ``hier_hosts``; closed forms in :func:`project_exchange`,
+        shared so model and projector cannot drift).  On a flat mesh
+        the split is the degenerate one — everything on the fast tier,
+        ``dcn_gather == 0`` — and the totals are bit-for-bit the
+        pre-hierarchy model's.  Both tier keys are a DECOMPOSITION of
+        the exchange, excluded from ``total`` like ``overlap_hidden``
+        (the exchange itself is charged once, via ``delta_gather``).
 
         Kernel terms replay the grid's actual DMA-descriptor sequence
         (ops/aligned_kernel.stream_plan): a block whose index map
@@ -1250,23 +1527,28 @@ class AlignedSimulator:
             terms["overlap_extra"] = (plan["tab"] * blk * C
                                       + plan["row"] * blk * C + 2 * wp)
         hidden = None
+        tier = None
         if n_shards > 1 and self._frontier_delta:
             # interconnect bytes of the exchange, per chip per round
-            # (the measure_round8 A/B's gathered-bytes column): the
-            # sparse table when the worst shard's changed words fit K,
-            # the dense frontier planes otherwise; the non-fused path
-            # additionally gathers the alive/byz mask planes it now
-            # applies post-exchange.
-            L = W * (R // n_shards) * C
-            K = frontier_capacity(self.frontier_threshold, L)
-            changed = int(fill * L)
-            delta = (n_shards * (2 * K + 1) * 4 if changed <= K
-                     else wp)
-            if not fused:
-                # the alive mask plane, gathered post-exchange each
-                # round (the static byzantine plane gathers once at
-                # carry init and is amortized to ~0)
-                delta += plane
+            # (the measure_round8/11 A/Bs' gathered-bytes columns):
+            # the sparse table when the worst shard's changed words
+            # fit K, the dense frontier planes otherwise; the
+            # non-fused path additionally gathers the alive/byz mask
+            # planes it now applies post-exchange.  Closed forms live
+            # in project_exchange, which also prices the per-tier
+            # split under the hier factorization.
+            # an explicit n_hosts is the caller's what-if question;
+            # None reads this sim's RESOLVED state (hier_mode off ->
+            # the flat exchange really runs -> flat pricing)
+            nh = (n_hosts if n_hosts is not None
+                  else (self.hier_hosts if self._hier else 0))
+            ex = project_exchange(
+                n_peers=R * C, n_msgs=self.n_msgs, n_shards=n_shards,
+                n_hosts=nh, frontier_fill=fill,
+                threshold=self.frontier_threshold, fused=fused,
+                rows=R)
+            delta = ex["delta_gather"]
+            tier = (ex["ici_gather"], ex["dcn_gather"])
             if overlap:
                 # the split moves the exchange off the critical path:
                 # its bytes land in ``overlap_hidden`` (reported,
@@ -1284,6 +1566,11 @@ class AlignedSimulator:
         terms["total"] = sum(terms.values())
         if hidden is not None:
             terms["overlap_hidden"] = int(hidden)
+        if tier is not None:
+            # per-tier decomposition of the exchange — reported next
+            # to it, never double-charged into ``total``
+            terms["ici_gather"] = int(tier[0])
+            terms["dcn_gather"] = int(tier[1])
         return terms
 
     def hbm_bytes_per_round(self) -> int:
@@ -1488,9 +1775,11 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
                   hash_seed: jax.Array | None = None,
                   msg_srcs: jax.Array | None = None,
                   fr: FrontierCarry | None = None,
-                  fr_axis: str | None = None,
+                  fr_axis=None,
                   fr_pmax_axes: tuple = (),
                   fr_shards: int = 1,
+                  fr_ici_axis: str | None = None,
+                  fr_hosts: int = 1,
                   n_shards: int = 1):
     """THE round implementation, shared by the single-chip engine,
     AlignedShardedSimulator (parallel/aligned_sharded.py) and the 2-D
@@ -1521,17 +1810,23 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
         exact program it always did.
       * ``fr``/``fr_axis``/``fr_pmax_axes``/``fr_shards`` — the
         frontier-sparse exchange (sharded engines only): a
-        :class:`FrontierCarry`, the mesh axis the send planes gather
-        over, the axes the regime signal reduces over, and the peer
-        shard count.  With ``fr`` the round REPLACES the dense send
-        gathers with :func:`_frontier_exchange`'s output (the global
-        frontier scatter and the per-chip seen replica), applies the
-        row permutation and the alive/byzantine send masks locally
-        POST-gather (so gathered content stays monotone), and returns
-        a 4-tuple ``(state, topo, metrics, fr')`` — every other
-        caller keeps the 3-tuple.  The fault plane's drop gates hash
-        (receiver, slot, round) — never the transported words — so
-        both paths see identical gate decisions by construction.
+        :class:`FrontierCarry`, the mesh axis (or axis tuple) the send
+        planes gather over, the axes the regime signal reduces over,
+        and the peer shard count.  With ``fr`` the round REPLACES the
+        dense send gathers with :func:`_frontier_exchange`'s output
+        (the global frontier scatter and the per-chip seen replica),
+        applies the row permutation and the alive/byzantine send masks
+        locally POST-gather (so gathered content stays monotone), and
+        returns a 4-tuple ``(state, topo, metrics, fr')`` — every
+        other caller keeps the 3-tuple.  The fault plane's drop gates
+        hash (receiver, slot, round) — never the transported words —
+        so both paths see identical gate decisions by construction.
+      * ``fr_ici_axis``/``fr_hosts`` — the hierarchical two-tier
+        exchange (round 11): when set, ``fr_axis`` is the slow DCN
+        (host) axis and ``fr_ici_axis`` the fast intra-host axis; the
+        exchange runs per tier with per-tier censuses and regimes
+        (``_frontier_exchange``'s hierarchical path), and the metrics
+        gain an ``fr_sparse_ici`` diagnostic next to ``fr_sparse``.
       * ``n_shards`` — the peer-axis shard count (1 for the solo and
         fleet engines).  With ``sim._overlap`` and a block-perm
         overlay, ``n_shards > 1`` engages the compute-hidden exchange:
@@ -1707,10 +2002,12 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
     # post-gather, bitwise-identically (AND and the row gather commute
     # elementwise with the all_gather layout).
     F_g = seen_g = g_alive = g_byz = g_defer = None
-    fr_sparse = fr_words = None
+    fr_sparse = fr_words = fr_sparse_ici = None
     if fr is not None:
-        F_g, fr, fr_sparse, fr_words = _frontier_exchange(
-            sim, frontier_w, fr, fr_axis, fr_pmax_axes, fr_shards)
+        F_g, fr, fr_sparse, fr_words, fr_sparse_ici = \
+            _frontier_exchange(
+                sim, frontier_w, fr, fr_axis, fr_pmax_axes, fr_shards,
+                ici_axis=fr_ici_axis, n_hosts=fr_hosts)
         seen_g = fr.replica_w
         if not fused:
             g_alive = gather(alive_w)
@@ -1957,4 +2254,9 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
     # engine's.
     metrics["fr_sparse"] = fr_sparse
     metrics["fr_words"] = fr_words
+    if fr_sparse_ici is not None:
+        # hierarchical meshes only: the ICI tier's regime this round
+        # (fr_sparse is then the DCN tier's — same census and capacity
+        # as the flat exchange, so that series stays bitwise flat)
+        metrics["fr_sparse_ici"] = fr_sparse_ici
     return state, topo, metrics, fr

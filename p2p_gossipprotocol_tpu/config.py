@@ -134,6 +134,21 @@ _SIM_INT_KEYS = {
     # behind the self-shard half of the push kernel — -1 auto / 0 / 1
     # (needs a block-perm overlay and a push pass; degrades recorded).
     "overlap_mode": "overlap_mode",
+    # aligned engine, sharded meshes: two-tier hierarchical exchange
+    # (round 11) — factorize the mesh_devices peer axis into
+    # hier_hosts x hier_devs (hosts = slow DCN tier, devs = fast ICI
+    # tier; hier_devs=0 derives devices/host when it divides).  The
+    # engines then stage every gather DCN-then-ICI and run the
+    # frontier delta exchange per tier, bitwise-identical to the flat
+    # exchange.  A factorization that doesn't divide the mesh DEGRADES
+    # to flat with a recorded clamp (aligned.resolve_hier — checked at
+    # engine-selection time like the msg_shards cross-field rules,
+    # since CLI flags can override the mesh after this file parses).
+    # hier_mode: -1 auto (two-tier on the compiled path, off under
+    # interpret — the frontier_mode rule), 0/1 force.
+    "hier_hosts": "hier_hosts",
+    "hier_devs": "hier_devs",
+    "hier_mode": "hier_mode",
     # aligned SIR engine: fuse the infectious-neighbor pressure count
     # into the gossip kernel's stream (one stream instead of the
     # permute prep + solo count_pass pair) — -1 auto / 0 / 1.
@@ -322,6 +337,11 @@ class NetworkConfig:
         self.prefetch_depth = -1
         self.overlap_mode = -1
         self.sir_fuse = -1
+        # Two-tier hierarchical exchange (round 11): hosts x devs
+        # factorization of the sharded peer axis (0 = flat mesh).
+        self.hier_hosts = 0
+        self.hier_devs = 0
+        self.hier_mode = -1
         self.rounds = 0
         self.message_stagger = 0       # 0 = all rumors at round 0
         self.mesh_devices = 0          # 0/1 = single device
@@ -520,6 +540,16 @@ class NetworkConfig:
             raise ConfigError("overlap_mode must be -1 (auto), 0, or 1")
         if self.sir_fuse not in (-1, 0, 1):
             raise ConfigError("sir_fuse must be -1 (auto), 0, or 1")
+        if self.hier_mode not in (-1, 0, 1):
+            raise ConfigError("hier_mode must be -1 (auto), 0, or 1")
+        if self.hier_hosts < 0 or self.hier_devs < 0:
+            raise ConfigError("hier_hosts/hier_devs must be >= 0")
+        # whether hier_hosts x hier_devs factorizes the mesh is checked
+        # at engine-selection time (aligned.resolve_hier, a recorded
+        # clamp-to-flat — never a crash): CLI flags may override
+        # mesh_devices/msg_shards after this file parses, so the
+        # factorization is only knowable there, the same reasoning as
+        # the msg_shards cross-field rules below.
         # msg_shards/mesh_devices CROSS-field rules are deliberately not
         # checked here: CLI flags may override engine/mode/mesh after
         # load, so the combination is validated at engine-selection time

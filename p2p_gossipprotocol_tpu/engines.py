@@ -167,7 +167,11 @@ def config_keys(cfg, n_peers: int | None = None) -> dict:
     (``prefetch_depth``, ``overlap_mode``, ``sir_fuse``) are excluded
     on the same bitwise-identity grounds (tests/test_prefetch.py,
     test_overlap.py, test_sir_fuse.py): they pick HOW the same blocks
-    move, never what the round computes.  The ``supervise_*`` keys are
+    move, never what the round computes.  The round-11 ``hier_*`` keys
+    are excluded for the same reason plus the elastic-resume one: the
+    two-tier exchange is pure routing (tests/test_hier.py pins hier ==
+    flat bitwise), and a run must migrate between mesh factorizations
+    — including hier -> flat — mid-flight.  The ``supervise_*`` keys are
     likewise excluded: supervision decides WHERE a run executes (how
     many worker processes, what deadlines), never its trajectory — a
     checkpoint written under supervision must resume unsupervised and
@@ -337,20 +341,38 @@ def build_simulator(cfg, *, n_peers: int | None = None,
             frontier_threshold=sim.frontier_threshold,
             prefetch_depth=sim.prefetch_depth,
             overlap_mode=sim.overlap_mode,
+            hier_mode=sim.hier_mode,
             seed=sim.seed)
         if msg_shards > 1:
             # 2-D mesh: message planes x peer rows (the SP analogue,
-            # parallel/aligned_2d.py)
+            # parallel/aligned_2d.py).  The hier factorization applies
+            # to the PEER sub-axis, so it re-resolves against that
+            # count (from_config resolved against the total — the
+            # clamp rule is shared, illegal combos degrade to flat).
+            from p2p_gossipprotocol_tpu.aligned import resolve_hier
             from p2p_gossipprotocol_tpu.parallel import (
                 Aligned2DShardedSimulator, make_mesh_2d)
 
             peer_shards = n_shards // msg_shards
+            hh, _hd = resolve_hier(cfg.hier_hosts, cfg.hier_devs,
+                                   peer_shards, clamps)
             sim = Aligned2DShardedSimulator(
-                mesh=make_mesh_2d(msg_shards, peer_shards), **lifted)
-            return sim, f"aligned-2d-{msg_shards}x{peer_shards}"
+                mesh=make_mesh_2d(msg_shards, peer_shards, n_hosts=hh),
+                **lifted)
+            name = f"aligned-2d-{msg_shards}x{peer_shards}"
+            return sim, (name + f"-hier{hh}" if hh else name)
         from p2p_gossipprotocol_tpu.parallel import (
-            AlignedShardedSimulator, make_mesh)
+            AlignedShardedSimulator, make_hier_mesh, make_mesh)
 
+        # from_config resolved the hier_* factorization against this
+        # shard count (illegal combos already clamped to flat); a
+        # resolved hosts x devs builds the two-axis mesh whose routing
+        # the engine reads off (parallel/mesh.py make_hier_mesh)
+        if sim.hier_hosts > 1:
+            mesh = make_hier_mesh(sim.hier_hosts, sim.hier_devs)
+            sim = AlignedShardedSimulator(mesh=mesh, **lifted)
+            return (sim, f"aligned-hier-{sim.n_hosts}x"
+                    f"{sim.devs_per_host}")
         sim = AlignedShardedSimulator(mesh=make_mesh(n_shards), **lifted)
         return sim, f"aligned-sharded-{n_shards}"
 

@@ -52,6 +52,12 @@ def bucket_signature(sim) -> tuple:
         # stays in the signature for the same one-program-per-bucket
         # discipline
         sim._prefetch, sim._overlap,
+        # resolved round-11 hierarchy statics: like the overlap split,
+        # the two-tier exchange never engages on the fleet's single
+        # device, but the resolved factorization rides the signature
+        # so a sweep mixing hier and flat scenario lines keeps the
+        # one-program-per-bucket discipline
+        sim.hier_hosts, sim.hier_devs, sim._hier,
         sim._liveness,
         (sim.churn.rate, sim.churn.revive, sim.churn.kill_round),
         sim.faults,            # frozen dataclass or None — hashable

@@ -28,7 +28,8 @@ from p2p_gossipprotocol_tpu.parallel.aligned_sharded import (
     AlignedShardedSimulator,
     AlignedShardedSIRSimulator,
 )
-from p2p_gossipprotocol_tpu.parallel.mesh import (make_mesh,
+from p2p_gossipprotocol_tpu.parallel.mesh import (make_hier_mesh,
+                                                  make_mesh,
                                                   make_survivor_mesh)
 from p2p_gossipprotocol_tpu.parallel.partition import (
     ShardedTopology,
@@ -39,6 +40,7 @@ from p2p_gossipprotocol_tpu.parallel.partition import (
 from p2p_gossipprotocol_tpu.parallel.sharded_sim import ShardedSimulator
 
 __all__ = [
+    "make_hier_mesh",
     "make_mesh",
     "make_mesh_2d",
     "make_survivor_mesh",

@@ -39,37 +39,50 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator, AlignedState,
                                             AlignedTopology, FrontierCarry,
-                                            aligned_round)
+                                            _hier_gather, aligned_round)
 from p2p_gossipprotocol_tpu.liveness import ChurnConfig
 from p2p_gossipprotocol_tpu.parallel.aligned_sharded import _topo_spec
-from p2p_gossipprotocol_tpu.parallel.mesh import (PEER_AXIS,
+from p2p_gossipprotocol_tpu.parallel.mesh import (HOST_AXIS, PEER_AXIS,
                                                    shard_map_compat)
 
 MSG_AXIS = "msgs"
 
 
 def make_mesh_2d(n_msg_shards: int, n_peer_shards: int,
-                 devices=None) -> Mesh:
+                 devices=None, n_hosts: int = 0) -> Mesh:
     """(msgs, peers) mesh over the first n_msg*n_peer devices.
 
     The peer axis is the MINOR (fastest-varying) axis of the device
     grid on purpose: it carries the per-round all_gather of the send
     words, so adjacent peer shards should sit on adjacent chips (ICI
-    neighbors on a real pod); the msg axis moves only scalar psums."""
+    neighbors on a real pod); the msg axis moves only scalar psums.
+
+    With ``n_hosts > 1`` the peer axis additionally factorizes over
+    the hierarchy seam — a ``(msgs, hosts, peers)`` mesh whose peer
+    sub-axes carry the two-tier exchange exactly like the 1-D
+    make_hier_mesh (the msg axis stays exchange-free either way)."""
     devices = jax.devices() if devices is None else devices
     need = n_msg_shards * n_peer_shards
     if len(devices) < need:
         raise ValueError(f"need {need} devices, have {len(devices)}")
+    if n_hosts and n_hosts > 1:
+        if n_peer_shards % n_hosts:
+            raise ValueError(
+                f"hier_hosts {n_hosts} does not factorize the "
+                f"{n_peer_shards}-shard peer axis of the 2-D mesh")
+        grid = np.asarray(devices[:need]).reshape(
+            n_msg_shards, n_hosts, n_peer_shards // n_hosts)
+        return Mesh(grid, (MSG_AXIS, HOST_AXIS, PEER_AXIS))
     grid = np.asarray(devices[:need]).reshape(n_msg_shards, n_peer_shards)
     return Mesh(grid, (MSG_AXIS, PEER_AXIS))
 
 
-def _state_spec(liveness: bool) -> AlignedState:
+def _state_spec(liveness: bool, axes=PEER_AXIS) -> AlignedState:
     return AlignedState(
-        seen_w=P(MSG_AXIS, PEER_AXIS, None),
-        frontier_w=P(MSG_AXIS, PEER_AXIS, None),
-        alive_b=P(PEER_AXIS, None), byz_w=P(PEER_AXIS, None),
-        strikes=P(None, PEER_AXIS, None) if liveness else None,
+        seen_w=P(MSG_AXIS, axes, None),
+        frontier_w=P(MSG_AXIS, axes, None),
+        alive_b=P(axes, None), byz_w=P(axes, None),
+        strikes=P(None, axes, None) if liveness else None,
         key=P(), round=P())
 
 
@@ -108,13 +121,26 @@ class Aligned2DShardedSimulator:
     #: gather exactly as on the 1-D engine.
     prefetch_depth: int = 0
     overlap_mode: int = 0
+    #: two-tier hierarchical exchange on the peer sub-axes (round 11;
+    #: needs a make_mesh_2d(..., n_hosts=H) mesh): same resolution and
+    #: bitwise contract as the 1-D engine's hier_mode.
+    hier_mode: int = -1
     seed: int = 0
     interpret: bool | None = None
 
     def __post_init__(self):
         if self.mesh is None:
             self.mesh = make_mesh_2d(self.n_msg_shards, self.n_peer_shards)
-        self.n_msg_shards, self.n_peer_shards = self.mesh.devices.shape
+        shape = tuple(int(s) for s in self.mesh.devices.shape)
+        self._hier_mesh = len(shape) == 3
+        if self._hier_mesh:
+            self.n_msg_shards, self.n_hosts, self.devs_per_host = shape
+            self.n_peer_shards = self.n_hosts * self.devs_per_host
+        else:
+            self.n_msg_shards, self.n_peer_shards = shape
+            self.n_hosts = self.devs_per_host = 0
+        self._paxes = ((HOST_AXIS, PEER_AXIS) if self._hier_mesh
+                       else PEER_AXIS)
         # The unsharded engine IS the semantics (same discipline as the
         # 1-D engine): validation, init_state, masks come from it.
         fr_kw = ({} if self.frontier_threshold is None
@@ -131,6 +157,8 @@ class Aligned2DShardedSimulator:
             frontier_mode=self.frontier_mode, **fr_kw,
             prefetch_depth=self.prefetch_depth,
             overlap_mode=self.overlap_mode,
+            hier_hosts=self.n_hosts, hier_devs=self.devs_per_host,
+            hier_mode=self.hier_mode,
             seed=self.seed,
             interpret=self.interpret)
         self.churn = self._inner.churn
@@ -138,6 +166,7 @@ class Aligned2DShardedSimulator:
         self.frontier_threshold = self._inner.frontier_threshold
         self._frontier = self._inner._frontier_delta
         self._liveness = self._inner._liveness
+        self._hier = self._inner._hier and self._hier_mesh
         W = self._inner.n_words
         if W % self.n_msg_shards:
             raise ValueError(
@@ -162,7 +191,7 @@ class Aligned2DShardedSimulator:
         the msg axis, rows over the peer axis)."""
         shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s),
-            _state_spec(self._liveness),
+            _state_spec(self._liveness, self._paxes),
             is_leaf=lambda x: isinstance(x, P))
         return jax.device_put(state, shardings)
 
@@ -170,7 +199,8 @@ class Aligned2DShardedSimulator:
                    ) -> AlignedTopology:
         topo = self.topo if topo is None else topo
         shardings = jax.tree.map(
-            lambda s: NamedSharding(self.mesh, s), _topo_spec(topo),
+            lambda s: NamedSharding(self.mesh, s),
+            _topo_spec(topo, self._paxes),
             is_leaf=lambda x: isinstance(x, P))
         return jax.device_put(topo, shardings)
 
@@ -193,21 +223,37 @@ class Aligned2DShardedSimulator:
             # msg-independent — replicated over the whole mesh)
             byz_g = jax.device_put(
                 state.byz_w, NamedSharding(self.mesh, P()))
-        return FrontierCarry(replica_w=replica, byz_g=byz_g,
-                             regime=jnp.int32(0))
+        return FrontierCarry(
+            replica_w=replica, byz_g=byz_g, regime=jnp.int32(0),
+            regime_ici=jnp.int32(0) if self._hier else None)
 
     def _fr_spec(self) -> FrontierCarry:
         return FrontierCarry(
             replica_w=(P(MSG_AXIS, None, None)
                        if self.mode in ("pull", "pushpull") else None),
             byz_g=P() if self.topo.ytab is None else None,
-            regime=P())
+            regime=P(),
+            regime_ici=P() if self._hier else None)
 
     # ------------------------------------------------------------------
+    def _gather(self, x):
+        """Globalize the rows axis over the peer sub-axes — staged
+        DCN-then-ICI on the two-tier path (aligned._hier_gather), one
+        all_gather otherwise.  The msg axis never gathers."""
+        if self._hier:
+            return _hier_gather(x, HOST_AXIS, PEER_AXIS, self.n_hosts,
+                                self.devs_per_host)
+        return jax.lax.all_gather(x, self._paxes, axis=x.ndim - 2,
+                                  tiled=True)
+
     def _step_local(self, state: AlignedState, topo: AlignedTopology,
                     fr: FrontierCarry | None = None):
         rows_l = state.seen_w.shape[1]
-        pidx = jax.lax.axis_index(PEER_AXIS)
+        if self._hier_mesh:
+            pidx = (jax.lax.axis_index(HOST_AXIS) * self.devs_per_host
+                    + jax.lax.axis_index(PEER_AXIS))
+        else:
+            pidx = jax.lax.axis_index(PEER_AXIS)
         grow0 = pidx * rows_l
         grows = grow0 + jnp.arange(rows_l, dtype=jnp.int32)
         t_off = (grow0 // topo.rowblk).astype(jnp.int32)
@@ -218,17 +264,26 @@ class Aligned2DShardedSimulator:
                                       (w_local,))
         jmask = jax.lax.dynamic_slice(self._inner._junk_mask, (w0,),
                                       (w_local,))
-        fr_kw = ({} if fr is None else dict(
-            fr=fr, fr_axis=PEER_AXIS,
-            fr_pmax_axes=(MSG_AXIS, PEER_AXIS),
-            fr_shards=self.n_peer_shards))
+        # the regime signal reduces over EVERY mesh axis so all devices
+        # take the same branch of the compiled conditional
+        all_axes = ((MSG_AXIS, HOST_AXIS, PEER_AXIS) if self._hier_mesh
+                    else (MSG_AXIS, PEER_AXIS))
+        if fr is None:
+            fr_kw = {}
+        elif self._hier:
+            fr_kw = dict(fr=fr, fr_axis=HOST_AXIS,
+                         fr_ici_axis=PEER_AXIS, fr_hosts=self.n_hosts,
+                         fr_pmax_axes=all_axes,
+                         fr_shards=self.n_peer_shards)
+        else:
+            fr_kw = dict(fr=fr, fr_axis=self._paxes,
+                         fr_pmax_axes=all_axes,
+                         fr_shards=self.n_peer_shards)
         return aligned_round(
             self._inner, state, topo, grows=grows, t_off=t_off,
-            gather=lambda x: jax.lax.all_gather(x, PEER_AXIS,
-                                                axis=x.ndim - 2,
-                                                tiled=True),
-            reduce=lambda x: jax.lax.psum(x, PEER_AXIS),
-            msg_reduce=lambda x: jax.lax.psum(x, (MSG_AXIS, PEER_AXIS)),
+            gather=self._gather,
+            reduce=lambda x: jax.lax.psum(x, self._paxes),
+            msg_reduce=lambda x: jax.lax.psum(x, all_axes),
             honest_mask=hmask, junk_mask=jmask, w_off=w0,
             msg_only_reduce=lambda x: jax.lax.psum(x, MSG_AXIS),
             n_shards=self.n_peer_shards, **fr_kw)
@@ -247,13 +302,15 @@ class Aligned2DShardedSimulator:
         topo = self.shard_topo(topo)
         fr = self.init_frontier(state)
         if rounds not in self._run_cache:
-            st_spec = _state_spec(self._liveness)
-            tp_spec = _topo_spec(self.topo)
+            st_spec = _state_spec(self._liveness, self._paxes)
+            tp_spec = _topo_spec(self.topo, self._paxes)
             metric_spec = {k: P() for k in ("coverage", "deliveries",
                                             "frontier_size", "live_peers",
                                             "evictions", "redeliveries")}
             if fr is not None:
                 metric_spec.update(fr_sparse=P(), fr_words=P())
+                if self._hier:
+                    metric_spec["fr_sparse_ici"] = P()
 
             if fr is None:
                 def scanned(st, tp):
@@ -293,6 +350,8 @@ class Aligned2DShardedSimulator:
         if fr is not None:
             res.fr_sparse = np.asarray(ys["fr_sparse"])
             res.fr_words = np.asarray(ys["fr_words"])
+            if self._hier:
+                res.fr_sparse_ici = np.asarray(ys["fr_sparse_ici"])
         return res
 
     def run_to_coverage(self, target: float = 0.99, max_rounds: int = 256,
@@ -313,8 +372,8 @@ class Aligned2DShardedSimulator:
         fr = self.init_frontier(state)
         cache_key = ("cov", target, max_rounds, check_every)
         if cache_key not in self._run_cache:
-            st_spec = _state_spec(self._liveness)
-            tp_spec = _topo_spec(self.topo)
+            st_spec = _state_spec(self._liveness, self._paxes)
+            tp_spec = _topo_spec(self.topo, self._paxes)
 
             from p2p_gossipprotocol_tpu.state import (build_coverage_loop,
                                                       stagger_sched_end)

@@ -40,35 +40,38 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator, AlignedState,
                                             AlignedTopology, FrontierCarry,
-                                            aligned_round)
+                                            _hier_gather, aligned_round)
 from p2p_gossipprotocol_tpu.aligned_sir import (AlignedSIRSimulator,
                                                 AlignedSIRState,
                                                 aligned_sir_round)
 from p2p_gossipprotocol_tpu.liveness import ChurnConfig
-from p2p_gossipprotocol_tpu.parallel.mesh import (PEER_AXIS, make_mesh,
+from p2p_gossipprotocol_tpu.parallel.mesh import (HOST_AXIS, PEER_AXIS,
+                                                   is_hier_mesh, make_mesh,
                                                    shard_map_compat)
 
 AXIS = PEER_AXIS
 
 
-def _topo_spec(topo: AlignedTopology) -> AlignedTopology:
+def _topo_spec(topo: AlignedTopology, axes=AXIS) -> AlignedTopology:
     """PartitionSpec tree for AlignedTopology: per-peer planes shard over
     rows; the permutation and roll tables are replicated (the permutation
     is int32[R] — 4 bytes/128 peers, trivially replicable).  Built with
     ``replace`` so the flax-struct static fields (part of the treedef)
-    match the real topology's."""
+    match the real topology's.  ``axes`` is the row dimension's mesh
+    axis — ``(HOST_AXIS, PEER_AXIS)`` on a hierarchical mesh, where the
+    factorized pair covers the same flat device order."""
     return topo.replace(
         perm=P(), rolls=P(), subrolls=P(),
-        colidx=P(None, AXIS, None), deg=P(AXIS, None),
-        valid_w=P(AXIS, None),
+        colidx=P(None, axes, None), deg=P(axes, None),
+        valid_w=P(axes, None),
         ytab=None if topo.ytab is None else P())
 
 
-def _state_spec(liveness: bool) -> AlignedState:
+def _state_spec(liveness: bool, axes=AXIS) -> AlignedState:
     return AlignedState(
-        seen_w=P(None, AXIS, None), frontier_w=P(None, AXIS, None),
-        alive_b=P(AXIS, None), byz_w=P(AXIS, None),
-        strikes=P(None, AXIS, None) if liveness else None,
+        seen_w=P(None, axes, None), frontier_w=P(None, axes, None),
+        alive_b=P(axes, None), byz_w=P(axes, None),
+        strikes=P(None, axes, None) if liveness else None,
         key=P(), round=P())
 
 
@@ -108,13 +111,28 @@ class AlignedShardedSimulator:
     #: schedule (tests/test_prefetch.py / test_overlap.py).
     prefetch_depth: int = 0
     overlap_mode: int = 0
+    #: two-tier hierarchical exchange (round 11): engages when the
+    #: mesh is a make_hier_mesh factorization AND this resolves on
+    #: (-1 auto = compiled path only, 0/1 force — the frontier_mode
+    #: rule).  Dense gathers stage DCN-then-ICI and the frontier
+    #: exchange runs per tier; bitwise-identical to the flat exchange
+    #: either way (tests/test_hier.py), so a hier mesh with the knob
+    #: off is a valid A/B of routing alone.
+    hier_mode: int = -1
     seed: int = 0
     interpret: bool | None = None
 
     def __post_init__(self):
         if self.mesh is None:
             self.mesh = make_mesh()
+        self._hier_mesh = is_hier_mesh(self.mesh)
+        if self._hier_mesh:
+            self.n_hosts, self.devs_per_host = (
+                int(s) for s in self.mesh.devices.shape)
+        else:
+            self.n_hosts = self.devs_per_host = 0
         self.n_shards = int(np.prod(self.mesh.devices.shape))
+        self._paxes = (HOST_AXIS, AXIS) if self._hier_mesh else AXIS
         rows, blk = self.topo.rows, self.topo.rowblk
         if rows % (self.n_shards * blk):
             raise ValueError(
@@ -138,6 +156,8 @@ class AlignedShardedSimulator:
             frontier_mode=self.frontier_mode, **fr_kw,
             prefetch_depth=self.prefetch_depth,
             overlap_mode=self.overlap_mode,
+            hier_hosts=self.n_hosts, hier_devs=self.devs_per_host,
+            hier_mode=self.hier_mode,
             seed=self.seed, interpret=self.interpret)
         self.churn = self._inner.churn
         self.interpret = self._inner.interpret
@@ -145,6 +165,10 @@ class AlignedShardedSimulator:
         self._liveness = self._inner._liveness
         self._n_honest = self._inner._n_honest
         self._frontier = self._inner._frontier_delta
+        #: the RESOLVED two-tier flag (needs the hier mesh + hier_mode
+        #: on); off, a hier mesh still runs — flat exchange over the
+        #: factorized axis pair, same values, one routing
+        self._hier = self._inner._hier and self._hier_mesh
         self._run_cache: dict = {}
         self._loop_cache: dict = {}
 
@@ -161,7 +185,7 @@ class AlignedShardedSimulator:
         step)."""
         shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s),
-            _state_spec(self._liveness),
+            _state_spec(self._liveness, self._paxes),
             is_leaf=lambda x: isinstance(x, P))
         return jax.device_put(state, shardings)
 
@@ -169,7 +193,8 @@ class AlignedShardedSimulator:
                    ) -> AlignedTopology:
         topo = self.topo if topo is None else topo
         shardings = jax.tree.map(
-            lambda s: NamedSharding(self.mesh, s), _topo_spec(topo),
+            lambda s: NamedSharding(self.mesh, s),
+            _topo_spec(topo, self._paxes),
             is_leaf=lambda x: isinstance(x, P))
         return jax.device_put(topo, shardings)
 
@@ -183,7 +208,8 @@ class AlignedShardedSimulator:
         (resume restarts dense and re-converges to the same regime on
         its own; the trajectory is regime-independent by the bitwise
         contract).  Pure push carries no replica at all — no pass reads
-        global seen."""
+        global seen.  On the two-tier path the carry additionally holds
+        the ICI tier's own regime flag (same derived-state rules)."""
         if not self._frontier:
             return None
         replica = byz_g = None
@@ -195,17 +221,33 @@ class AlignedShardedSimulator:
             # fused path masks through src_ok instead)
             byz_g = jax.device_put(
                 state.byz_w, NamedSharding(self.mesh, P()))
-        return FrontierCarry(replica_w=replica, byz_g=byz_g,
-                             regime=jnp.int32(0))
+        return FrontierCarry(
+            replica_w=replica, byz_g=byz_g, regime=jnp.int32(0),
+            regime_ici=jnp.int32(0) if self._hier else None)
 
     def _fr_spec(self) -> FrontierCarry:
         return FrontierCarry(
             replica_w=(P() if self.mode in ("pull", "pushpull")
                        else None),
             byz_g=P() if self.topo.ytab is None else None,
-            regime=P())
+            regime=P(),
+            regime_ici=P() if self._hier else None)
 
     # ------------------------------------------------------------------
+    def _gather(self, x):
+        """Globalize the ROWS axis (ndim-2: axis 0 of the 2D alive
+        words, axis 1 of the 3D [W, rows, 128] message planes).  On the
+        two-tier path the gather stages DCN-then-ICI (each row slice
+        crosses the inter-host tier once per host pair instead of once
+        per remote chip — aligned._hier_gather); otherwise one
+        all_gather over the peer axis (or the factorized axis pair,
+        same flat order)."""
+        if self._hier:
+            return _hier_gather(x, HOST_AXIS, AXIS, self.n_hosts,
+                                self.devs_per_host)
+        return jax.lax.all_gather(x, self._paxes, axis=x.ndim - 2,
+                                  tiled=True)
+
     def _step_local(self, state: AlignedState, topo: AlignedTopology,
                     fr: FrontierCarry | None = None):
         """One full round on this shard's row blocks — the SAME
@@ -215,31 +257,45 @@ class AlignedShardedSimulator:
         reduce = psum.  With ``fr`` the round runs the frontier-sparse
         exchange and returns the 4-tuple including the updated carry."""
         rows_l = state.seen_w.shape[1]          # local rows
-        sidx = jax.lax.axis_index(AXIS)
+        if self._hier_mesh:
+            # flat shard index from the factorized pair (host-major —
+            # make_hier_mesh pins the same device order as make_mesh)
+            sidx = (jax.lax.axis_index(HOST_AXIS) * self.devs_per_host
+                    + jax.lax.axis_index(AXIS))
+        else:
+            sidx = jax.lax.axis_index(AXIS)
         grow0 = sidx * rows_l
         grows = grow0 + jnp.arange(rows_l, dtype=jnp.int32)
         t_off = (grow0 // topo.rowblk).astype(jnp.int32)
-        fr_kw = ({} if fr is None else dict(
-            fr=fr, fr_axis=AXIS, fr_pmax_axes=(AXIS,),
-            fr_shards=self.n_shards))
+        if fr is None:
+            fr_kw = {}
+        elif self._hier:
+            fr_kw = dict(fr=fr, fr_axis=HOST_AXIS, fr_ici_axis=AXIS,
+                         fr_hosts=self.n_hosts,
+                         fr_pmax_axes=(HOST_AXIS, AXIS),
+                         fr_shards=self.n_shards)
+        else:
+            fr_kw = dict(fr=fr, fr_axis=self._paxes,
+                         fr_pmax_axes=((HOST_AXIS, AXIS)
+                                       if self._hier_mesh else (AXIS,)),
+                         fr_shards=self.n_shards)
         return aligned_round(
             self._inner, state, topo, grows=grows, t_off=t_off,
-            # gather the ROWS axis (ndim-2): axis 0 of the 2D alive
-            # words, axis 1 of the 3D [W, rows, 128] message planes
-            gather=lambda x: jax.lax.all_gather(x, AXIS, axis=x.ndim - 2,
-                                                tiled=True),
-            reduce=lambda x: jax.lax.psum(x, AXIS),
+            gather=self._gather,
+            reduce=lambda x: jax.lax.psum(x, self._paxes),
             n_shards=self.n_shards, **fr_kw)
 
     # ------------------------------------------------------------------
     def _specs(self):
-        st = _state_spec(self._liveness)
-        tp = _topo_spec(self.topo)
+        st = _state_spec(self._liveness, self._paxes)
+        tp = _topo_spec(self.topo, self._paxes)
         metric = {k: P() for k in ("coverage", "deliveries",
                                    "frontier_size", "live_peers",
                                    "evictions", "redeliveries")}
         if self._frontier:
             metric.update(fr_sparse=P(), fr_words=P())
+            if self._hier:
+                metric["fr_sparse_ici"] = P()
         return st, tp, metric
 
     def run(self, rounds: int, state: AlignedState | None = None,
@@ -301,6 +357,8 @@ class AlignedShardedSimulator:
             # count) — not SimResult fields, attached for the A/B
             res.fr_sparse = np.asarray(ys["fr_sparse"])
             res.fr_words = np.asarray(ys["fr_words"])
+            if self._hier:
+                res.fr_sparse_ici = np.asarray(ys["fr_sparse_ici"])
         return res
 
     def run_to_coverage(self, target: float = 0.99, max_rounds: int = 256,
@@ -394,6 +452,12 @@ class AlignedShardedSIRSimulator:
     def __post_init__(self):
         if self.mesh is None:
             self.mesh = make_mesh()
+        if is_hier_mesh(self.mesh):
+            raise ValueError(
+                "the sharded SIR engine has no hierarchical exchange "
+                "(its per-round traffic is one pressure plane) — use "
+                "make_mesh, or the gossip engines for the two-tier "
+                "path")
         self.n_shards = int(np.prod(self.mesh.devices.shape))
         rows, blk = self.topo.rows, self.topo.rowblk
         if rows % (self.n_shards * blk):

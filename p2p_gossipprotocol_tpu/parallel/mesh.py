@@ -5,6 +5,17 @@ the peer dimension of state arrays and the edge dimension of the
 partitioned overlay both map onto it (edges live with the shard that owns
 their source peer, so the dissemination gather is local and only the
 scatter crosses shards).
+
+Since round 11 the peer axis can be FACTORIZED into a two-tier
+hierarchy (:func:`make_hier_mesh`): a ``"hosts"`` major axis whose hops
+are slow inter-host links (DCN) and a minor intra-host axis (ICI) whose
+bandwidth is nearly free.  The aligned sharded engines read the
+factorization off the mesh and route their exchange per tier — dense
+all-gathers within a host, scatter-compacted frontier deltas between
+hosts (aligned._frontier_exchange; docs/ARCHITECTURE.md "The hierarchy
+seam").  A flat mesh remains one collective domain, and a hierarchical
+mesh with the two-tier exchange disabled runs the same flat exchange
+over the factorized axes — bitwise-identical either way.
 """
 
 from __future__ import annotations
@@ -15,6 +26,10 @@ import jax
 from jax.sharding import Mesh
 
 PEER_AXIS = "peers"
+#: the major (slow, inter-host / DCN) axis of a hierarchical mesh; the
+#: minor axis keeps the ``PEER_AXIS`` name so flat-mesh PartitionSpecs
+#: generalize by substituting ``(HOST_AXIS, PEER_AXIS)`` for the row dim
+HOST_AXIS = "hosts"
 
 
 def shard_map_compat(f, *, mesh, in_specs, out_specs):
@@ -41,10 +56,16 @@ def make_mesh(n_devices: int | None = None,
               devices: list | None = None) -> Mesh:
     """A 1-D mesh over ``n_devices`` (default: all available devices).
 
-    The real-hardware layout (v5e-8, v5e-64, multi-slice) and the virtual
-    CPU test layout (``--xla_force_host_platform_device_count``) go through
-    the same path; XLA routes the collectives over ICI within a slice and
-    DCN across slices on its own.
+    This is the FLAT layout: one collective domain, every hop priced
+    the same, with the ICI-vs-DCN routing of a multi-slice deployment
+    left to XLA.  When the deployment's topology is known, prefer
+    :func:`make_hier_mesh` — the engines then split their per-round
+    exchange across the hierarchy seam explicitly (dense over ICI,
+    compacted deltas over DCN) instead of pushing every gathered byte
+    through whatever route XLA picks.  The real-hardware layout
+    (v5e-8, v5e-64, multi-slice) and the virtual CPU test layout
+    (``--xla_force_host_platform_device_count``) go through the same
+    path either way.
     """
     devs = devices if devices is not None else jax.devices()
     if n_devices is not None:
@@ -55,12 +76,48 @@ def make_mesh(n_devices: int | None = None,
     return Mesh(np.asarray(devs), (PEER_AXIS,))
 
 
-def make_survivor_mesh(n_survivors: int, devs_per_proc: int,
-                       devices: list | None = None) -> Mesh:
-    """The shrink-to-survivors mesh (runtime/supervisor.py): a 1-D
-    mesh over the surviving process set's devices.
+def make_hier_mesh(n_hosts: int, devs_per_host: int,
+                   devices: list | None = None) -> Mesh:
+    """The two-tier hierarchical mesh: ``(hosts, peers)`` over the
+    first ``n_hosts * devs_per_host`` devices, host-major — device
+    ``(h, d)`` is flat device ``h * devs_per_host + d``, so every
+    row/edge partitioning is bitwise the flat mesh's for the same
+    device count (the hierarchy changes ROUTING, never ownership).
 
-    Deterministic in ``(n_survivors, devs_per_proc)`` alone — the
+    The major ``hosts`` axis models the slow tier (DCN between hosts /
+    pod slices); the minor ``peers`` axis the fast tier (ICI within a
+    host).  On real hardware pass the device list so adjacent minor
+    neighbors really are ICI neighbors; on the virtual CPU test layout
+    the factorization is purely logical, which is exactly what the
+    bitwise hier==flat parity suite (tests/test_hier.py) needs.
+    ``n_hosts=1`` is the degenerate flat-as-hier layout (legal — the
+    engines resolve the two-tier exchange off for it)."""
+    if n_hosts < 1 or devs_per_host < 1:
+        raise ValueError(
+            f"hier mesh needs >= 1 host and >= 1 device/host "
+            f"(got {n_hosts} x {devs_per_host})")
+    devs = devices if devices is not None else jax.devices()
+    need = n_hosts * devs_per_host
+    if need > len(devs):
+        raise ValueError(
+            f"requested {need} devices ({n_hosts} hosts x "
+            f"{devs_per_host}), have {len(devs)}")
+    grid = np.asarray(devs[:need]).reshape(n_hosts, devs_per_host)
+    return Mesh(grid, (HOST_AXIS, PEER_AXIS))
+
+
+def is_hier_mesh(mesh: Mesh) -> bool:
+    """Does this mesh carry the two-tier peer-axis factorization?"""
+    return HOST_AXIS in tuple(getattr(mesh, "axis_names", ()))
+
+
+def make_survivor_mesh(n_survivors: int, devs_per_proc: int,
+                       devices: list | None = None,
+                       hier: bool = False) -> Mesh:
+    """The shrink-to-survivors mesh (runtime/supervisor.py): a mesh
+    over the surviving process set's devices.
+
+    Deterministic in ``(n_survivors, devs_per_proc, hier)`` alone — the
     supervised worker rebuilds exactly this mesh on every recovery
     attempt, so the shrunk layout is a pure function of the failure
     history and the resumed trajectory is the one the elastic
@@ -69,9 +126,19 @@ def make_survivor_mesh(n_survivors: int, devs_per_proc: int,
     ``jax.distributed`` the surviving processes' devices ARE the
     device list; in single-process (chief) rehearsal mode the chief
     was launched owning ``n_survivors * devs_per_proc`` virtual
-    devices."""
+    devices.
+
+    With ``hier`` the survivors form the HOST axis of a
+    :func:`make_hier_mesh` — each surviving process is one host of
+    ``devs_per_proc`` ICI-local devices, so a shrink re-derives the
+    two-tier factorization instead of flattening it (a 4-host
+    hierarchical job that loses a host recovers as a 3-host
+    hierarchical job, and the exchange keeps its per-tier routing)."""
     if n_survivors < 1 or devs_per_proc < 1:
         raise ValueError(
             f"survivor mesh needs >= 1 process and >= 1 device/process "
             f"(got {n_survivors} x {devs_per_proc})")
+    if hier:
+        return make_hier_mesh(n_survivors, devs_per_proc,
+                              devices=devices)
     return make_mesh(n_survivors * devs_per_proc, devices=devices)

@@ -9,8 +9,14 @@ sharded form of SURVEY.md §7 hard part (b): churn and rewiring mutate
 
 Owning edges by *source* keeps the hot-path gather (``frontier[src]``)
 shard-local; only the delivery scatter crosses shards (one
-``psum_scatter`` per round — the ICI collective that replaces the
-reference's per-message TCP sends, peer.cpp:310-312).
+``psum_scatter`` per round — the collective that replaces the
+reference's per-message TCP sends, peer.cpp:310-312).  This edge-list
+partitioner treats the mesh as ONE collective domain and leaves the
+ICI-vs-DCN routing of that scatter to XLA; the hierarchy seam — dense
+exchange within a host, compacted frontier deltas between hosts over a
+``make_hier_mesh`` factorization — lives in the aligned engines
+(aligned._frontier_exchange; docs/ARCHITECTURE.md "The hierarchy
+seam"), which is where the scale path runs.
 
 ``gidx`` maps each local edge slot back to its global edge index so that
 per-edge randomness can be drawn *globally* (from the replicated key) and

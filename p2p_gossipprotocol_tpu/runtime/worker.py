@@ -99,7 +99,11 @@ def _hold(args, hb_path: str) -> int:
 def _build_sim(cfg, args, mesh_devices: int):
     """The supervised scenario on ``mesh_devices`` devices, overlay
     statics pinned to the ORIGINAL ``total_ranks × devs_per_proc``
-    grid (see module docstring)."""
+    grid (see module docstring).  With ``hier_hosts`` configured the
+    survivor mesh keeps the two-tier factorization — survivors form
+    the host axis (make_survivor_mesh hier=), so a shrink re-derives
+    the hierarchical layout instead of flattening it and the resumed
+    exchange keeps its per-tier routing."""
     from p2p_gossipprotocol_tpu.aligned import build_aligned
     from p2p_gossipprotocol_tpu.liveness import ChurnConfig
     from p2p_gossipprotocol_tpu.parallel import AlignedShardedSimulator
@@ -116,12 +120,15 @@ def _build_sim(cfg, args, mesh_devices: int):
     return AlignedShardedSimulator(
         topo=topo,
         mesh=make_survivor_mesh(mesh_devices // args.devs_per_proc,
-                                args.devs_per_proc),
+                                args.devs_per_proc,
+                                hier=cfg.hier_hosts > 1),
         n_msgs=n_msgs, mode=cfg.mode, churn=churn,
         max_strikes=cfg.max_missed_pings,
         message_stagger=cfg.message_stagger,
         pull_window=bool(cfg.pull_window),
         fuse_update=bool(cfg.fuse_update),
+        frontier_mode=cfg.frontier_mode,
+        hier_mode=cfg.hier_mode,
         seed=cfg.prng_seed)
 
 

@@ -89,6 +89,9 @@ def test_bench_reports_traffic_model():
     assert rec["achieved_gb_s"] is not None
     assert rec["liveness_every"] == 3
     assert rec["roll_groups"] == 4
+    # round-11 per-tier columns appear ONLY under GOSSIP_BENCH_HOSTS —
+    # headline rows stay comparable across rounds
+    assert "dcn_gb" not in rec and "ici_gb" not in rec
 
 
 def test_bench_steady_state_and_loop_knobs():
@@ -125,6 +128,24 @@ def test_bench_fallback_omits_steady_and_carries_tpu_pointer():
     # came from, so a stale committed headline can't pass as fresh
     assert tpu["source"] in ("working-tree", "HEAD")
     assert tpu.get("recorded_at")
+
+
+def test_bench_hier_tier_columns():
+    """Round-11 per-tier columns: GOSSIP_BENCH_HOSTS > 1 adds the
+    ici/dcn split of the exchange under the requested hosts x devs
+    factorization — integer byte fields on the row make the gb columns
+    reproducible from the artifacts alone (the roofline_frac
+    discipline), and the DCN column sits strictly under the ICI one
+    (the whole point of routing the slow tier sparsely)."""
+    proc, rec = _run({"GOSSIP_BENCH_PLATFORM": "cpu",
+                      "JAX_PLATFORMS": "cpu",
+                      "GOSSIP_BENCH_HOSTS": "2",
+                      "GOSSIP_BENCH_HOST_DEVS": "4"})
+    assert proc.returncode == 0, proc.stderr
+    assert rec["hier_hosts"] == 2 and rec["hier_devs"] == 4
+    assert rec["ici_bytes_round"] > rec["dcn_bytes_round"] > 0
+    assert abs(rec["ici_gb"] - rec["ici_bytes_round"] / 1e9) <= 1e-6
+    assert abs(rec["dcn_gb"] - rec["dcn_bytes_round"] / 1e9) <= 1e-6
 
 
 def test_bench_stagger_and_block_perm_knobs():

@@ -177,6 +177,83 @@ def test_frontier_terms_match_closed_form():
     assert w_sparse["delta_gather"] * 2 <= w_dense["delta_gather"]
 
 
+def test_hier_tier_terms_match_closed_form():
+    """Round-11 per-tier terms, pinned closed-form on both paths.
+
+    Flat-mesh degenerate case: the tier split exists but everything
+    rides the fast tier — ``dcn_gather == 0``, ``ici_gather`` equals
+    the whole exchange, and the TOTALS are bit-for-bit today's model
+    (the tier keys are a decomposition, excluded from ``total`` like
+    ``overlap_hidden``).  Hierarchical case: the DCN tier moves H-1
+    per-device tables per chip (vs the flat exchange's S-D — the
+    D-fold redundant inter-host delivery the hierarchy deletes), the
+    ICI tier D-1 column tables under its own capacity, and the
+    non-fused mask plane is staged the same way."""
+    from p2p_gossipprotocol_tpu.aligned import (frontier_capacity,
+                                                project_exchange)
+
+    S, H = 8, 2
+    D = S // H
+    on = _sim(roll_groups=4, rowblk=64, frontier_mode=1)
+    W, R, C = on.n_words, on.topo.rows, 128
+    L = W * (R // S) * C
+    K = frontier_capacity(on.frontier_threshold, L)
+    Kc = frontier_capacity(on.frontier_threshold, L * H)
+    plane = R * C * 4
+    sl = (R // S) * C * 4
+    fill = K / (2 * L)
+    # flat degenerate: dcn == 0, ici == delta, total matches today's
+    flat = on.traffic_model(frontier_fill=fill, n_shards=S)
+    assert flat["dcn_gather"] == 0
+    assert flat["ici_gather"] == flat["delta_gather"] \
+        == S * (2 * K + 1) * 4 + plane
+    assert flat["total"] == sum(
+        v for k, v in flat.items()
+        if k not in ("total", "ici_gather", "dcn_gather"))
+    assert flat["total"] == on.traffic_model(
+        frontier_fill=fill, n_shards=S, n_hosts=1)["total"]
+    # hierarchical: per-tier closed forms (sparse regime)
+    hier = on.traffic_model(frontier_fill=fill, n_shards=S, n_hosts=H)
+    assert hier["dcn_gather"] == (H - 1) * ((2 * K + 1) * 4 + sl)
+    assert hier["ici_gather"] == (D - 1) * ((2 * Kc + 1) * 4 + H * sl)
+    assert hier["delta_gather"] == hier["ici_gather"] \
+        + hier["dcn_gather"]
+    # dense regime: H-1 device slices over DCN, D-1 column planes ICI
+    dense = on.traffic_model(frontier_fill=1.0, n_shards=S, n_hosts=H)
+    assert dense["dcn_gather"] == (H - 1) * (L * 4 + sl)
+    assert dense["ici_gather"] == (D - 1) * H * (L * 4 + sl)
+    # the projector is THE shared closed form, and its flat-DCN column
+    # carries the acceptance ratio: >= 2x post-peak (expected ~D)
+    ex = project_exchange(n_peers=R * C, n_msgs=on.n_msgs, n_shards=S,
+                          n_hosts=H, frontier_fill=fill,
+                          threshold=on.frontier_threshold, rows=R)
+    assert ex["dcn_gather"] == hier["dcn_gather"]
+    assert ex["ici_gather"] == hier["ici_gather"]
+    assert ex["flat_dcn"] == (S - D) * ((2 * K + 1) * 4 + sl)
+    assert ex["flat_dcn"] >= 2 * ex["dcn_gather"]
+    # a sim whose RESOLVED hier statics are on prices hier by default
+    h_sim = _sim(roll_groups=4, rowblk=64, frontier_mode=1,
+                 hier_hosts=H, hier_devs=D, hier_mode=1)
+    assert h_sim.traffic_model(frontier_fill=fill, n_shards=S) == hier
+    # ... and hier_mode=0 (flat exchange really runs) prices flat
+    h_off = _sim(roll_groups=4, rowblk=64, frontier_mode=1,
+                 hier_hosts=H, hier_devs=D, hier_mode=0)
+    assert h_off.traffic_model(frontier_fill=fill, n_shards=S) == flat
+
+
+def test_project_exchange_1b_budget():
+    """The 1B-peer projection (ROADMAP item 1): finite closed-form
+    per-tier GB/round with no topology build, hier DCN well under the
+    flat exchange's."""
+    from p2p_gossipprotocol_tpu.aligned import project_exchange
+
+    ex = project_exchange(n_peers=1 << 30, n_msgs=256, n_shards=256,
+                          n_hosts=64, frontier_fill=0.001, fused=True)
+    assert 0 < ex["dcn_gather"] < ex["flat_dcn"]
+    assert ex["flat_dcn"] >= 2 * ex["dcn_gather"]
+    assert ex["delta_gather"] == ex["ici_gather"] + ex["dcn_gather"]
+
+
 def test_overlap_terms_match_closed_form():
     """Round-10 overlap terms, pinned on both paths: off keeps the
     legacy accounting bit-for-bit; on charges the split's honest extra
