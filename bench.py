@@ -64,6 +64,13 @@ independent-seed scenarios as one batched fleet bucket (fleet/) at
 GOSSIP_BENCH_FLEET_PEERS (64k) and report fleet_wall_s /
 fleet_ms_per_scenario — the amortized sweep-throughput column; the
 solo-vs-fleet A/B lives in benchmarks/measure_round7.py.
+GOSSIP_BENCH_SERVE (0 = off): also run N requests through the
+RESIDENT continuous-batching server (serve/GossipService, in-process)
+at GOSSIP_BENCH_SERVE_PEERS (16k) x GOSSIP_BENCH_SERVE_SLOTS (8) and
+report serve_p50_ms / serve_p99_ms (admission-to-result latency) and
+serve_qps — reproducible from the row alone as serve_n /
+serve_wall_s; the offered-load sweep with Poisson arrivals lives in
+benchmarks/measure_round12.py.
 """
 
 from __future__ import annotations
@@ -482,6 +489,23 @@ def _bench_aligned(n, n_msgs, degree, mode):
         except Exception as e:  # noqa: BLE001 — column, not the line
             print(f"[bench] fleet column failed ({type(e).__name__}: "
                   f"{e}); omitting fleet fields", file=sys.stderr)
+    # Serving columns (GOSSIP_BENCH_SERVE > 0): N independent-seed
+    # requests through the resident continuous-batching server —
+    # p50/p99 admission-to-result latency plus throughput.  serve_qps
+    # is reproducible from the row alone (serve_n / serve_wall_s, the
+    # roofline_frac provenance discipline); a serve failure degrades
+    # to a line without serve fields, never to no line.
+    serve = {}
+    serve_n = _env_int("GOSSIP_BENCH_SERVE", 0)
+    if serve_n > 0:
+        try:
+            serve = _bench_serve(
+                serve_n,
+                _env_int("GOSSIP_BENCH_SERVE_PEERS", 1 << 14),
+                _env_int("GOSSIP_BENCH_SERVE_SLOTS", 8))
+        except Exception as e:  # noqa: BLE001 — column, not the line
+            print(f"[bench] serve column failed ({type(e).__name__}: "
+                  f"{e}); omitting serve fields", file=sys.stderr)
     extras = {
         "liveness_every": liveness_every,
         "roll_groups": roll_groups,
@@ -504,8 +528,49 @@ def _bench_aligned(n, n_msgs, degree, mode):
         **hier,
         **steady,
         **fleet,
+        **serve,
     }
     return rounds, wall, total_seen, n_edges, graph_s, extras
+
+
+def _bench_serve(n_req: int, n_peers: int, slots: int) -> dict:
+    """The serving columns: submit ``n_req`` independent-seed scenarios
+    to an in-process resident server (max offered load — everything
+    enqueued up front), wait for every row, report the p50/p99
+    admission-to-result latency and the sustained qps.  The Poisson
+    offered-load sweep (and the 5x-vs-sequential acceptance A/B) lives
+    in benchmarks/measure_round12.py."""
+    import tempfile
+
+    from p2p_gossipprotocol_tpu.config import NetworkConfig
+    from p2p_gossipprotocol_tpu.serve import GossipService
+
+    cfg_text = (f"127.0.0.1:8000\nbackend=jax\nn_peers={n_peers}\n"
+                f"n_messages=16\navg_degree=8\nrounds=64\n")
+    with tempfile.NamedTemporaryFile("w", suffix=".txt",
+                                     delete=False) as f:
+        f.write(cfg_text)
+        path = f.name
+    try:
+        cfg = NetworkConfig(path)
+    finally:
+        os.unlink(path)
+    svc = GossipService(cfg, slots=slots, queue_max=max(n_req, 1),
+                        target=TARGET_COV, rounds=MAX_ROUNDS).start()
+    t0 = time.perf_counter()
+    rids = [svc.submit({"prng_seed": s}) for s in range(n_req)]
+    for rid in rids:
+        svc.result(rid, timeout=1800)
+    wall = time.perf_counter() - t0
+    stats = svc.drain()
+    return {
+        "serve_n": n_req, "serve_peers": n_peers,
+        "serve_slots": slots,
+        "serve_wall_s": round(wall, 4),
+        "serve_p50_ms": stats["p50_ms"],
+        "serve_p99_ms": stats["p99_ms"],
+        "serve_qps": round(n_req / wall, 3) if wall > 0 else None,
+    }
 
 
 def _bench_edges(n, n_msgs, degree, mode):

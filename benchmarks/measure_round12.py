@@ -1,0 +1,295 @@
+"""Round-12 A/B: the resident continuous-batching server (serve/)
+against the two batch shapes it supersedes.
+
+Two measurement families, every row with ``parity_ok`` (the serve
+results of the first/last scenario compared bitwise against their solo
+runs — the full cross-product lives in tests/test_serve.py):
+
+* ``serve_ab_b{B}``: the SAME B scenarios (per-scenario seeds, a
+  quarter of peer counts off-grid and padded back — one program
+  signature, so all three shapes serve one B-wide bucket and the
+  ratio measures the SERVING SHAPE, not bucket-width provisioning;
+  the multi-signature routing path is covered by the Poisson sweep
+  below and tests/test_serve.py) served three ways:
+
+  - ``_serve``: all B submitted up-front to a resident server with B
+    slots/bucket (max offered load — the continuous-batching ceiling),
+    recording wall, qps, p50/p99 admission-to-result latency, and
+    ``recompiles`` (chunk retraces; must equal the bucket count —
+    admission into a hot bucket compiles NOTHING);
+  - ``_solo``: each scenario run sequentially on the solo engine for
+    exactly the rounds the server ran it (identical work, warm cache —
+    the conservative baseline, same reasoning as round 7);
+  - ``_fleet``: the batch-offline FleetSweep (PR 4's shape: resolve,
+    run, exit) under the same convergence target.
+
+  Acceptance (ISSUE 9): serve >= 5x the sequential solo wall at B=64 x
+  64k peers on the CPU bench path, with zero admission recompiles.
+
+* ``serve_poisson_r{rate}``: N requests arriving as a SEEDED Poisson
+  process at ``rate`` req/s (3 rates — under, near, and past the
+  server's drain rate), recording p50/p99 admission-to-result latency
+  and sustained qps.  This is the serving headline the ROADMAP names:
+  latency under offered load, not just batch throughput.
+
+Run on the chip (watchdog chain step measure_round12):
+    PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/measure_round12.py
+Appends one JSON row per measurement to GOSSIP_R12_OUT (default
+benchmarks/results/round12_tpu.jsonl on TPU, round12_cpu.jsonl
+elsewhere), resuming per-config like the round-7/8 drivers.  Knobs:
+GOSSIP_R12_PEERS (64k), GOSSIP_R12_B ("64"), GOSSIP_R12_TARGET (0.99),
+GOSSIP_R12_RATES ("2,8,32"), GOSSIP_R12_N (24),
+GOSSIP_R12_POISSON_PEERS (16k), GOSSIP_R12_SEED (0).
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+
+def _out_path(cpu: bool) -> str:
+    default = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "results",
+        "round12_cpu.jsonl" if cpu else "round12_tpu.jsonl")
+    return os.environ.get("GOSSIP_R12_OUT", default)
+
+
+OUT = None          # set in main() once the platform is known
+
+
+def emit(row):
+    row["device"] = str(jax.devices()[0]).replace(" ", "_")
+    row["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    print(json.dumps(row), flush=True)
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def _landed() -> set:
+    from benchmarks._common import landed
+    return landed(OUT)
+
+
+def _landed_row(tag):
+    try:
+        with open(OUT) as f:
+            for line in f:
+                row = json.loads(line)
+                if row.get("config") == tag:
+                    return row
+    except OSError:
+        pass
+    return None
+
+
+def _specs(b: int, n: int) -> list[dict]:
+    """B signature-identical scenario lines: per-scenario seeds, every
+    4th peer count off the power-of-two grid (padded back by the spec
+    layer — the packing seam still works).  One signature on purpose:
+    a resident bucket is FIXED-width, so a 64-slot bucket serving an
+    8-scenario signature family pays 8x its width in compute — the
+    A/B must compare serving shapes at equal provisioning, and the
+    routing/multi-bucket path is measured by the Poisson sweep."""
+    specs = []
+    for s in range(b):
+        line = {"prng_seed": s}
+        if s % 4 == 1:
+            line["n_peers"] = n - n // 8
+        specs.append(line)
+    return specs
+
+
+def _cfg(n: int, rounds: int):
+    from p2p_gossipprotocol_tpu.config import NetworkConfig
+
+    cfg_text = (f"127.0.0.1:8000\nbackend=jax\nn_peers={n}\n"
+                f"n_messages=16\navg_degree=8\nrounds={rounds}\n")
+    with tempfile.NamedTemporaryFile("w", suffix=".txt",
+                                     delete=False) as f:
+        f.write(cfg_text)
+        path = f.name
+    try:
+        return NetworkConfig(path)
+    finally:
+        os.unlink(path)
+
+
+def _state_equal(a, b) -> bool:
+    for k in ("seen_w", "frontier_w", "alive_b", "byz_w", "key",
+              "round"):
+        if not np.array_equal(
+                np.asarray(jax.device_get(getattr(a.state, k))),
+                np.asarray(jax.device_get(getattr(b.state, k)))):
+            return False
+    return bool(np.array_equal(np.asarray(a.coverage),
+                               np.asarray(b.coverage)))
+
+
+def _parity(svc, rows, rids, specs, cfg, probe=(0, -1)) -> bool:
+    """First/last served scenario vs its solo run at the same rounds."""
+    from p2p_gossipprotocol_tpu.fleet import build_scenarios
+
+    ok = True
+    for p in probe:
+        rid, row = rids[p], rows[p]
+        res = svc.sim_result(rid)
+        if res is None:
+            ok = False
+            continue
+        solo = build_scenarios(cfg, [specs[p]])[0].sim.run(
+            row["rounds_run"])
+        ok = ok and _state_equal(res, solo)
+    return ok
+
+
+def bench_serve_ab(b: int, n: int, target: float, done):
+    serve_tag = f"serve_ab_b{b}_serve"
+    solo_tag = f"serve_ab_b{b}_solo"
+    fleet_tag = f"serve_ab_b{b}_fleet"
+    if all(t in done for t in (serve_tag, solo_tag, fleet_tag)):
+        return
+    from p2p_gossipprotocol_tpu.fleet import FleetSweep, build_scenarios
+    from p2p_gossipprotocol_tpu.serve import GossipService
+
+    specs = _specs(b, n)
+    cfg = _cfg(n, rounds=128)
+
+    # -- continuous serve: all B offered up-front, B slots ------------
+    serve_rows = None
+    if serve_tag not in done or solo_tag not in done:
+        svc = GossipService(cfg, slots=b, queue_max=b, max_buckets=4,
+                            target=target, rounds=128).start()
+        t0 = time.perf_counter()
+        rids = [svc.submit(s) for s in specs]
+        serve_rows = [svc.result(r, timeout=3600) for r in rids]
+        serve_wall = time.perf_counter() - t0
+        stats = svc.stats()
+        parity = _parity(svc, serve_rows, rids, specs, cfg)
+        svc.drain()
+        if serve_tag not in done:
+            emit({"config": serve_tag, "b": b, "n_peers": n,
+                  "target": target,
+                  "wall_s": round(serve_wall, 4),
+                  "qps": round(b / serve_wall, 3),
+                  "p50_ms": stats.get("p50_ms"),
+                  "p99_ms": stats.get("p99_ms"),
+                  "n_buckets": stats["buckets"],
+                  "recompiles": stats["chunk_retraces"],
+                  "zero_admission_recompiles":
+                      stats["chunk_retraces"] == stats["buckets"],
+                  "parity_ok": parity})
+
+    # -- sequential solo: identical per-scenario work ------------------
+    if solo_tag not in done:
+        rounds_run = [r["rounds_run"] for r in serve_rows]
+        sims = [s.sim for s in build_scenarios(cfg, specs)]
+        t0 = time.perf_counter()
+        for sim, r in zip(sims, rounds_run):
+            sim.run(r)
+        solo_wall = time.perf_counter() - t0
+        srow = _landed_row(serve_tag)
+        emit({"config": solo_tag, "b": b, "n_peers": n,
+              "wall_s": round(solo_wall, 4),
+              "ms_per_scenario": round(solo_wall / b * 1e3, 1),
+              "serve_speedup": round(
+                  solo_wall / srow["wall_s"], 2) if srow else None})
+    else:
+        solo_wall = _landed_row(solo_tag)["wall_s"]
+
+    # -- batch-offline fleet (PR 4's shape) ----------------------------
+    if fleet_tag not in done:
+        sweep = FleetSweep.from_config(cfg, specs=specs)
+        sweep.results_path = None
+        t0 = time.perf_counter()
+        sweep.run(128, target=target)
+        fleet_wall = time.perf_counter() - t0
+        srow = _landed_row(serve_tag)
+        emit({"config": fleet_tag, "b": b, "n_peers": n,
+              "wall_s": round(fleet_wall, 4),
+              "serve_vs_fleet": round(
+                  fleet_wall / srow["wall_s"], 2) if srow else None})
+
+
+def bench_poisson(rate: float, n_req: int, n: int, target: float,
+                  seed: int, done):
+    tag = f"serve_poisson_r{rate:g}"
+    if tag in done:
+        return
+    import random
+
+    from p2p_gossipprotocol_tpu.serve import GossipService
+
+    cfg = _cfg(n, rounds=128)
+    # heterogeneous offered load: every 6th request is mode=pull — a
+    # second program signature, so the sweep also measures routing and
+    # scale-out bucket opening under load
+    specs = [{"prng_seed": s, **({"mode": "pull"} if s % 6 == 5
+                                 else {})} for s in range(n_req)]
+    # seeded exponential inter-arrivals: the offered-load process is
+    # reproducible from the row alone (rate + seed + n ride it)
+    rng = random.Random(seed)
+    gaps = [rng.expovariate(rate) for _ in range(n_req)]
+    svc = GossipService(cfg, slots=8, queue_max=n_req, max_buckets=4,
+                        target=target, rounds=128).start()
+    t0 = time.perf_counter()
+    rids = []
+    for s, gap in zip(specs, gaps):
+        time.sleep(gap)
+        rids.append(svc.submit(s))
+    rows = [svc.result(r, timeout=3600) for r in rids]
+    wall = time.perf_counter() - t0
+    stats = svc.stats()
+    parity = _parity(svc, rows, rids, specs, cfg)
+    svc.drain()
+    emit({"config": tag, "rate_rps": rate, "n": n_req, "n_peers": n,
+          "seed": seed, "target": target,
+          "offered_s": round(sum(gaps), 4),
+          "wall_s": round(wall, 4),
+          "qps": round(n_req / wall, 3),
+          "p50_ms": stats.get("p50_ms"),
+          "p99_ms": stats.get("p99_ms"),
+          # under load the scheduler scales OUT (opens same-signature
+          # buckets up to the cap); each bucket compiles exactly once
+          "n_buckets": stats["buckets"],
+          "recompiles": stats["chunk_retraces"],
+          "zero_admission_recompiles":
+              stats["chunk_retraces"] == stats["buckets"],
+          "parity_ok": parity})
+
+
+def main():
+    global OUT
+    backend = jax.default_backend()
+    on_tpu = backend in ("tpu", "axon")
+    OUT = _out_path(cpu=not on_tpu)
+    n = int(os.environ.get("GOSSIP_R12_PEERS", str(1 << 16)))
+    target = float(os.environ.get("GOSSIP_R12_TARGET", "0.99"))
+    bs = [int(x) for x in
+          os.environ.get("GOSSIP_R12_B", "64").split(",") if x]
+    rates = [float(x) for x in
+             os.environ.get("GOSSIP_R12_RATES", "2,8,32").split(",")
+             if x]
+    n_req = int(os.environ.get("GOSSIP_R12_N", "24"))
+    pn = int(os.environ.get("GOSSIP_R12_POISSON_PEERS", str(1 << 14)))
+    seed = int(os.environ.get("GOSSIP_R12_SEED", "0"))
+    done = _landed()
+    if "_backend" not in done:
+        emit({"config": "_backend", "backend": backend, "n_peers": n,
+              "target": target})
+    for b in bs:
+        bench_serve_ab(b, n, target, done)
+    for rate in rates:
+        bench_poisson(rate, n_req, pn, target, seed, done)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -109,7 +109,7 @@ record_fail() {
   fi
 }
 
-STEP_NAMES="bench mosaic_smoke measure_round4 measure_round5 measure_round6 measure_round7 measure_round8 measure_round9 measure_round10 measure_round11 baselines multihost longrun"
+STEP_NAMES="bench mosaic_smoke measure_round4 measure_round5 measure_round6 measure_round7 measure_round8 measure_round9 measure_round10 measure_round11 measure_round12 baselines multihost longrun"
 # Headline first: a short tunnel window must yield the most important
 # artifact.  bench keeps its file contract (ONE parsed line) and only
 # stamps when the line really came from the chip.  longrun is the
@@ -140,6 +140,10 @@ PY" ;;
     # on silicon + the overlap trace; ROADMAP item 4), since
     # measure_round10.py resumes per-config from its landed rows
     measure_round11) echo "python benchmarks/measure_round11.py" ;;
+    # round-12: the resident continuous-batching server vs the
+    # sequential and batch-offline shapes, plus the Poisson
+    # offered-load latency sweep (p50/p99 admission-to-result)
+    measure_round12) echo "python benchmarks/measure_round12.py" ;;
     baselines)      echo "python benchmarks/run_baselines.py" ;;
     multihost)
       # the multi-host step is DELEGATED to the runtime supervisor
@@ -173,6 +177,7 @@ step_tmo() {
     measure_round9) echo 3600 ;;
     measure_round10) echo 3600 ;;
     measure_round11) echo 3600 ;;
+    measure_round12) echo 3600 ;;
     baselines) echo 4800 ;;
     multihost) echo 1800 ;;
     longrun) echo 1800 ;;

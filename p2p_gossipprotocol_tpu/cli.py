@@ -73,6 +73,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "table (JSONL) here; default: the "
                         "sweep_results= config key, else rows print to "
                         "stdout")
+    p.add_argument("--serve", action="store_true",
+                   help="jax mode: run as a RESIDENT gossip-sim server "
+                        "(serve/): scenarios arrive as sweep-line "
+                        "config dicts over local_ip:local_port "
+                        "(wire_format= framing), are admitted into hot "
+                        "fleet buckets at round boundaries "
+                        "(continuous batching — zero recompilation on "
+                        "a signature hit), and every result is "
+                        "bitwise-identical to the scenario's solo "
+                        "run.  SIGINT/SIGTERM with --checkpoint-dir "
+                        "salvages in-flight buckets + the queue and "
+                        "exits 75; --serve --resume re-hydrates them. "
+                        "Config twins: serve=1 and the serve_* keys "
+                        "(docs/ARCHITECTURE.md \"The serving seam\")")
     p.add_argument("--mesh-devices", type=int, default=None, metavar="N",
                    help="jax mode: shard the peer axis over an N-device "
                         "mesh (ShardedSimulator / "
@@ -318,6 +332,74 @@ def _run_fleet(sweep, cfg, args, rounds) -> int:
     return 0
 
 
+def _run_serve(cfg: NetworkConfig, args) -> int:
+    """Run the resident gossip-sim server (serve/): GossipService under
+    a ServeServer on the config's socket address.  The preemption
+    contract mirrors the sweep driver's: SIGINT/SIGTERM with a
+    checkpoint dir salvage every in-flight bucket AND the queue at the
+    next chunk boundary and exit 75 (resumable); without one they
+    drain gracefully (finish what was admitted, then exit 0)."""
+    from p2p_gossipprotocol_tpu.serve.server import ServeServer
+    from p2p_gossipprotocol_tpu.serve.service import GossipService
+    from p2p_gossipprotocol_tpu.utils.checkpoint import (CheckpointError,
+                                                         EX_RESUMABLE)
+
+    log = None if args.quiet else (
+        lambda msg: print(msg, file=sys.stderr))
+    try:
+        service = GossipService(
+            cfg, n_peers=args.n_peers,
+            rounds=args.rounds or None,
+            checkpoint_dir=args.checkpoint_dir,
+            results_path=args.sweep_results or None,
+            resume=args.resume, log=log)
+    except (CheckpointError, ValueError) as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    server = ServeServer(service, cfg.get_local_ip(),
+                         cfg.get_local_port(),
+                         wire_format=cfg.wire_format, log=log)
+    stop = {"salvage": False}
+
+    def handler(signum, frame):
+        if service.checkpoint_dir:
+            print("\nReceived signal to terminate — salvaging "
+                  "in-flight buckets and the queue at the next chunk "
+                  "boundary, then exiting resumable (code 75; re-run "
+                  "with --serve --resume).", file=sys.stderr)
+            stop["salvage"] = True
+        else:
+            print("\nReceived signal to terminate — draining "
+                  "(no --checkpoint-dir, so in-flight work finishes "
+                  "before exit).", file=sys.stderr)
+        server._stop.set()
+
+    signal.signal(signal.SIGINT, handler)
+    signal.signal(signal.SIGTERM, handler)
+    try:
+        server.start()
+    except OSError as e:
+        print(f"Error: cannot bind {cfg.get_local_ip()}:"
+              f"{cfg.get_local_port()} ({e})", file=sys.stderr)
+        return 1
+    if not args.quiet:
+        print(f"[jax/serve] resident server on {cfg.get_local_ip()}:"
+              f"{cfg.get_local_port()} — {service.slots} slots/bucket, "
+              f"<= {service.max_buckets} buckets, queue <= "
+              f"{service.scheduler.queue_max}, target "
+              f"{service.target:g}, chunk {service.chunk}")
+    server.wait()
+    server.stop()
+    if stop["salvage"]:
+        service.salvage()
+        st = service.stats()
+        print(json.dumps({"engine": "serve", "salvaged": True, **st}))
+        return EX_RESUMABLE
+    stats = service.drain()
+    print(json.dumps({"engine": "serve", **stats}))
+    return 0
+
+
 def _run_supervise(cfg: NetworkConfig, args) -> int:
     """Drive the scenario as a supervised multi-process job
     (runtime/supervisor.py): launch supervise_workers worker
@@ -540,6 +622,22 @@ def main(argv: list[str] | None = None) -> int:
               "runtime is the reference's in-memory-only model)",
               file=sys.stderr)
         return 1
+
+    if args.serve or cfg.serve:
+        # resident server: the process stays up serving submissions;
+        # the one-shot simulation path below never runs
+        if cfg.backend != "jax":
+            print("Error: --serve is a jax-backend feature (the "
+                  "socket runtime is one real peer process; the serve "
+                  "protocol shares its wire, not its role)",
+                  file=sys.stderr)
+            return 1
+        if cfg.mode == "sir":
+            print("Error: --serve serves the gossip modes (the fleet "
+                  "engine batches push/pull/pushpull scenarios)",
+                  file=sys.stderr)
+            return 1
+        return _run_serve(cfg, args)
 
     if args.supervise or cfg.supervise:
         # supervised multi-process run: the supervisor owns the worker

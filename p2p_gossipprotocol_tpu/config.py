@@ -194,6 +194,19 @@ _SIM_INT_KEYS = {
     # row as n_peers_requested vs n_peers — never silent).
     "sweep_max_batch": "sweep_max_batch",
     "sweep_pad_peers": "sweep_pad_peers",
+    # Serving plane (serve/; jax backend): serve=1 runs a RESIDENT
+    # continuous-batching server over the fleet engine — scenarios
+    # arrive as sweep-line config dicts over the socket surface
+    # (local_ip/local_port, wire_format) or the GossipService facade,
+    # are admitted into hot buckets at round boundaries (slots freed by
+    # convergence masking), and every result stays bitwise-identical
+    # to the scenario's solo run.  CLI twin: --serve.
+    "serve": "serve",
+    "serve_slots": "serve_slots",
+    "serve_queue_max": "serve_queue_max",
+    "serve_max_buckets": "serve_max_buckets",
+    "serve_chunk": "serve_chunk",
+    "serve_rounds": "serve_rounds",
     # Self-healing multi-process runs (runtime/supervisor.py; jax
     # backend, engine=aligned): supervise=1 launches the run as
     # supervise_workers worker processes under the health plane —
@@ -224,6 +237,10 @@ _SIM_FLOAT_KEYS = {
     # Fleet engine: coverage target for convergence masking + bucket
     # early-exit (0 = run every scenario the full fixed round count).
     "sweep_target": "sweep_target",
+    # Serving plane: the convergence target that RETIRES a served
+    # scenario (frees its slot); must be in (0, 1) — a server without
+    # a retirement rule would hold slots forever.
+    "serve_target": "serve_target",
     # aligned engine: frontier-sparse delta-exchange capacity as a
     # fraction of each shard's packed words — the sparse regime engages
     # when every shard's changed-word count fits (with hysteresis;
@@ -261,6 +278,9 @@ _SIM_STR_KEYS = {
     # the per-scenario results table lands.
     "sweep_file": "sweep_file",
     "sweep_results": "sweep_results",
+    # Serving plane: where served-scenario rows append (concurrency-
+    # safe O_APPEND writes — fleet.driver.append_rows).
+    "serve_results": "serve_results",
     # Supervision spmd mode: auto (try jax.distributed, fall back to
     # the single-process-spmd chief rehearsal where multi-process
     # collectives don't exist), or force either.
@@ -373,6 +393,15 @@ class NetworkConfig:
         self.sweep_max_batch = 256       # widest bucket (overflow splits)
         self.sweep_pad_peers = 1         # pad n_peers to powers of two
         self.sweep_target = 0.0          # >0 = early-exit coverage target
+        # Serving plane (serve/): resident continuous-batching server
+        self.serve = 0                   # 1 = run as a resident server
+        self.serve_slots = 8             # slots per resident bucket
+        self.serve_queue_max = 64        # bounded admission queue
+        self.serve_max_buckets = 4       # resident signature buckets
+        self.serve_chunk = 8             # rounds per admission boundary
+        self.serve_rounds = 0            # per-scenario cap; 0 = rounds/64
+        self.serve_target = 0.99         # retirement coverage target
+        self.serve_results = ""          # served-rows JSONL (append)
         # Self-healing supervision (runtime/supervisor.py)
         self.supervise = 0               # 1 = run under the supervisor
         self.supervise_workers = 2       # worker processes in the job
@@ -503,9 +532,18 @@ class NetworkConfig:
                   "checkpoint_every", "checkpoint_resume",
                   "sweep_max_batch", "sweep_pad_peers",
                   "supervise", "supervise_max_failures",
-                  "supervise_grace_s", "supervise_deadline_s"):
+                  "supervise_grace_s", "supervise_deadline_s",
+                  "serve", "serve_rounds"):
             if getattr(self, k) < 0:
                 raise ConfigError(f"{k} must be non-negative")
+        for k in ("serve_slots", "serve_queue_max", "serve_max_buckets",
+                  "serve_chunk"):
+            if getattr(self, k) < 1:
+                raise ConfigError(f"{k} must be >= 1")
+        if not (0.0 < self.serve_target < 1.0):
+            raise ConfigError(
+                "serve_target must be in (0, 1) — a served scenario "
+                "retires (frees its slot) at this coverage")
         if self.supervise:
             if self.supervise_workers < 1 \
                     or self.supervise_devs_per_proc < 1:

@@ -131,12 +131,23 @@ def probe_backend() -> bool:
         tmo = float(os.environ.get("GOSSIP_PROBE_TIMEOUT_S", "90"))
     except ValueError:
         tmo = 90.0    # malformed knob must not take down an entry point
-    try:
-        ok = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            capture_output=True, timeout=tmo).returncode == 0
-    except (subprocess.TimeoutExpired, OSError):
-        ok = False
+
+    def _probe_once() -> bool:
+        try:
+            return subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                capture_output=True, timeout=tmo).returncode == 0
+        except (subprocess.TimeoutExpired, OSError):
+            return False
+
+    ok = _probe_once()
+    if not ok:
+        # Retry ONCE before pinning: the verdict is memoized for the
+        # whole process, so a TRANSIENT probe failure (tunnel blip,
+        # subprocess spawn race at container start) must not condemn
+        # every later simulator to CPU-forever — only a CONFIRMED miss
+        # (two probes in a row) pins (ADVICE round-5 residue).
+        ok = _probe_once()
     if not ok:
         print("[gossip] accelerator backend unavailable (init hung or "
               "failed) — simulating on CPU instead (results are "

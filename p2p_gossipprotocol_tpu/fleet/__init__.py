@@ -34,7 +34,8 @@ mixed-bucket sweep produces a result **bitwise-identical** to its solo
 ``AlignedSimulator`` run.
 """
 
-from p2p_gossipprotocol_tpu.fleet.driver import FleetSweep, SweepResult
+from p2p_gossipprotocol_tpu.fleet.driver import (FleetSweep, SweepResult,
+                                                 append_rows, read_rows)
 from p2p_gossipprotocol_tpu.fleet.engine import BucketResult, FleetBucket
 from p2p_gossipprotocol_tpu.fleet.packer import bucket_signature, pack
 from p2p_gossipprotocol_tpu.fleet.spec import (ScenarioSpec,
@@ -44,5 +45,5 @@ from p2p_gossipprotocol_tpu.fleet.spec import (ScenarioSpec,
 __all__ = [
     "FleetSweep", "SweepResult", "FleetBucket", "BucketResult",
     "bucket_signature", "pack", "ScenarioSpec", "build_scenarios",
-    "parse_sweep_file",
+    "parse_sweep_file", "append_rows", "read_rows",
 ]

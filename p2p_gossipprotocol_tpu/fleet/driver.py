@@ -49,6 +49,46 @@ _STATE_LEAVES = ("seen_w", "frontier_w", "alive_b", "byz_w", "key",
                  "round")
 
 
+def append_rows(path: str, rows: list[dict]) -> None:
+    """Concurrency-safe results-JSONL append: O_APPEND + ONE ``write()``
+    per row.  POSIX makes an O_APPEND write atomic with respect to the
+    file offset, so interleaved writers (serve workers finishing
+    scenarios, the salvage path flushing rows, a resumed sweep) can
+    never splice bytes inside each other's rows — the old
+    whole-table-rewrite discipline was atomic but single-writer, and
+    the serving plane has many.  A row never contains a newline
+    (``json.dumps`` default), so one row is exactly one line."""
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        for r in rows:
+            line = (json.dumps(r) + "\n").encode()
+            os.write(fd, line)
+    finally:
+        os.close(fd)
+
+
+def read_rows(path: str) -> list[dict]:
+    """Read a results-JSONL table, skipping torn lines.  A writer
+    crashing mid-``write()`` can leave at most one partial row (no
+    trailing newline, or truncated JSON); the reader drops any line
+    that does not parse instead of failing the whole table — the
+    torn-line twin of the checkpoint layer's torn-write discipline."""
+    rows = []
+    try:
+        with open(path, "rb") as fp:
+            data = fp.read()
+    except OSError:
+        return rows
+    for ln in data.split(b"\n"):
+        if not ln.strip():
+            continue
+        try:
+            rows.append(json.loads(ln))
+        except (ValueError, UnicodeDecodeError):
+            continue               # torn row (crash mid-write): skip
+    return rows
+
+
 @dataclass
 class SweepResult:
     """Whole-sweep outcome.  ``results[i]`` is scenario i's SimResult,
@@ -233,13 +273,25 @@ class FleetSweep:
         hist["_converged_round"] = payload["hist/_converged_round"]
         return state, topo, done, hist, int(entry["rounds_done"])
 
-    def _write_rows(self, rows: list[dict]) -> None:
+    def _init_results(self, rows: list[dict]) -> None:
+        """(Re)initialize the results table at run start — the one
+        single-writer moment: a fresh sweep truncates, a resumed sweep
+        rewrites the already-completed rows (atomic), and everything
+        after this appends via :func:`append_rows` so concurrent
+        writers (serve workers, the salvage path) stay safe."""
         if not self.results_path:
             return
         from p2p_gossipprotocol_tpu.utils.checkpoint import _write_atomic
 
         _write_atomic(self.results_path,
                       "".join(json.dumps(r) + "\n" for r in rows))
+
+    def _write_rows(self, rows: list[dict]) -> None:
+        """Append newly completed rows (O_APPEND, one write per row —
+        torn-line-safe under concurrent writers; see append_rows)."""
+        if not self.results_path:
+            return
+        append_rows(self.results_path, rows)
 
     # ------------------------------------------------------------------
     def run(self, rounds: int, target: float | None = None,
@@ -287,6 +339,14 @@ class FleetSweep:
         results: list = [None] * len(self.scenarios)
         interrupted = False
         t0 = time.perf_counter()
+        # the single-writer moment: fresh sweep -> truncate; resume ->
+        # rewrite the completed buckets' rows.  Everything later appends.
+        self._init_results(
+            [r for b in range(len(self.buckets))
+             for r in (manifest["buckets"].get(str(b)) or {}).get(
+                 "rows", [])
+             if (manifest["buckets"].get(str(b)) or {}).get(
+                 "status") == "done"])
         for b in range(len(self.buckets)):
             entry = manifest["buckets"].get(str(b))
             if entry and entry.get("status") == "done":
@@ -344,7 +404,7 @@ class FleetSweep:
                 log(f"[fleet] bucket {b}: {len(self.buckets[b])} "
                     f"scenarios, {int(bres.rounds_run.max())} rounds, "
                     f"{n_conv} converged, {bres.wall_s:.2f}s")
-            self._write_rows(rows)
+            self._write_rows(brows)
             if checkpoint_dir:
                 manifest["buckets"][str(b)] = {"status": "done",
                                                "rows": brows}

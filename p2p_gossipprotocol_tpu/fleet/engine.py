@@ -114,10 +114,22 @@ class FleetBucket:
     ``AlignedSimulator.from_config``, the same path the CLI takes) —
     the bucket only ever *batches* them, never rebuilds or reshapes
     them, which is what makes the bitwise-parity contract provable.
+
+    The serving plane (serve/) keeps a bucket RESIDENT: ``init_idle``
+    stacks the template into an all-done batch, :meth:`admit_into`
+    scatters one scenario's state/topology/seed/srcs into a freed slot
+    between chunks, and :meth:`mark_done` retires a slot.  All three
+    are value-only array updates against the one cached chunk program —
+    ``trace_count`` counts chunk retraces so the serving tests can
+    assert admission never recompiles.
     """
 
     sims: list                         # list[AlignedSimulator]
     _chunk_cache: dict = field(default_factory=dict, repr=False)
+    #: chunk-program retrace counter: the traced body bumps it once per
+    #: jit trace, so a resident bucket can PROVE slot-swap admission
+    #: stayed compilation-free (the serving plane's acceptance gate).
+    trace_count: int = field(default=0, repr=False)
 
     def __post_init__(self):
         if not self.sims:
@@ -156,6 +168,118 @@ class FleetBucket:
         return bstate, btopo
 
     # ------------------------------------------------------------------
+    @classmethod
+    def for_serving(cls, sim, slots: int) -> "FleetBucket":
+        """A ``slots``-wide resident bucket seeded from one template
+        scenario: every slot holds a copy of the template (inert once
+        ``init_idle`` marks it done), and the serving plane scatters
+        real scenarios in via :meth:`admit_into`.  The template fixes
+        the bucket's program signature; admission never changes it."""
+        if slots < 1:
+            raise ValueError("a serving bucket needs at least one slot")
+        return cls([sim] * slots)
+
+    def init_idle(self):
+        """(bstate, btopo, done): the template's world tiled across
+        every slot, all marked done — inert filler, ready for
+        admissions.  Tiles ONE init_state/topology instead of calling
+        :meth:`init`'s per-sim path (a serving bucket's slots all start
+        as the same template, and at 64 slots x 64k peers the 64
+        redundant init_state computations dominated server start)."""
+        st = self.template.init_state()
+        bstate = jax.tree.map(lambda x: jnp.stack([x] * self.size), st)
+        topo = self.template.topo
+        kw = {k: jnp.stack([getattr(topo, k)] * self.size)
+              for k in ALIGNED_TOPO_LEAVES}
+        btopo = AlignedTopology(
+            **kw,
+            ytab=(None if topo.ytab is None
+                  else jnp.stack([topo.ytab] * self.size)),
+            n_peers=topo.n_peers, n_slots=topo.n_slots,
+            rowblk=topo.rowblk, roll_groups=topo.roll_groups,
+            reuse_leak=topo.reuse_leak)
+        return bstate, btopo, jnp.ones(self.size, bool)
+
+    def admit_args(self, sim):
+        """Host-side per-slot payload for :meth:`admit_into`: the
+        scenario's exact solo init state, its overlay leaves, liveness
+        hash seed, and staggered source row — everything per-scenario
+        the vmapped round reads.  Built OUTSIDE the scatter so the
+        serving loop can stage the next admissions while the current
+        chunk still runs on-device (host->HBM overlap)."""
+        state = sim.init_state()
+        leaves = {k: getattr(sim.topo, k) for k in ALIGNED_TOPO_LEAVES}
+        ytab = sim.topo.ytab
+        seed = jnp.int32(sim.seed)
+        if self.template.message_stagger > 0:
+            srcs_row = sim._message_plan()[1]
+        else:
+            srcs_row = jnp.zeros((1,), jnp.int32)
+        return state, leaves, ytab, seed, srcs_row
+
+    def _admit_fn(self):
+        """Cached jitted scatter: write one scenario's world into slot
+        ``slot`` of the resident batch and un-done the slot.  ``slot``
+        is a traced scalar, so admissions at different slots share one
+        compilation; on accelerator backends the batch buffers are
+        donated (the slot swap reuses the retiree's HBM)."""
+        if "admit" in self._chunk_cache:
+            return self._chunk_cache["admit"]
+        has_ytab = self.template.topo.ytab is not None
+
+        def admit(bstate, btopo, done, seeds, srcs, slot,
+                  nstate, nleaves, nytab, seed, srcs_row):
+            bstate = jax.tree.map(lambda b, n: b.at[slot].set(n),
+                                  bstate, nstate)
+            upd = {k: getattr(btopo, k).at[slot].set(nleaves[k])
+                   for k in ALIGNED_TOPO_LEAVES}
+            if has_ytab:
+                upd["ytab"] = btopo.ytab.at[slot].set(nytab)
+            btopo = btopo.replace(**upd)
+            done = done.at[slot].set(False)
+            seeds = seeds.at[slot].set(seed)
+            srcs = srcs.at[slot].set(srcs_row)
+            return bstate, btopo, done, seeds, srcs
+
+        # donation is a no-op (with a warning) on CPU — only ask for it
+        # where the runtime can honor it
+        donate = (jax.default_backend() not in ("cpu",))
+        fn = jax.jit(admit, donate_argnums=(0, 1, 2, 3, 4) if donate
+                     else ())
+        self._chunk_cache["admit"] = fn
+        return fn
+
+    def admit_into(self, bstate, btopo, done, seeds, srcs, slot: int,
+                   sim=None, payload=None):
+        """Scatter ``sim`` (or a pre-staged :meth:`admit_args` payload)
+        into ``slot``; returns the updated (bstate, btopo, done, seeds,
+        srcs).  The admitted scenario must share the bucket signature —
+        the serving scheduler guarantees it, and the check here keeps a
+        mis-routed admission a named error instead of silent state
+        corruption."""
+        if payload is None:
+            if bucket_signature(sim) != bucket_signature(self.template):
+                raise ValueError(
+                    "admitted scenario does not match the bucket's "
+                    "program signature (scheduler routing bug)")
+            payload = self.admit_args(sim)
+        state, leaves, ytab, seed, srcs_row = payload
+        if ytab is None:       # jit wants a concrete operand either way
+            ytab = jnp.zeros((1,), jnp.int32)
+        return self._admit_fn()(bstate, btopo, done, seeds, srcs,
+                                jnp.int32(slot), state, leaves, ytab,
+                                seed, srcs_row)
+
+    def mark_done(self, done, slot: int):
+        """Retire ``slot``: the done mask freezes it on-device (inert —
+        the convergence-masking machinery, reused as the slot-free
+        primitive)."""
+        if "mark" not in self._chunk_cache:
+            self._chunk_cache["mark"] = jax.jit(
+                lambda d, s: d.at[s].set(True))
+        return self._chunk_cache["mark"](done, jnp.int32(slot))
+
+    # ------------------------------------------------------------------
     def _chunk_fn(self, length: int, target: float | None):
         """Compiled ``length``-round lockstep chunk with in-scan
         convergence masking; cached per (length, target)."""
@@ -175,6 +299,11 @@ class FleetBucket:
         vstep = jax.vmap(one)
 
         def chunk(bstate, btopo, done, seeds, srcs):
+            # trace-time only: one bump per compilation of this chunk
+            # program — the serving tests read it to assert slot-swap
+            # admission stayed compilation-free
+            self.trace_count += 1
+
             def body(carry, _):
                 bs, bt, dn = carry
                 ns, nt, m = vstep(bs, bt, seeds, srcs)

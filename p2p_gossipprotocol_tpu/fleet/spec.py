@@ -49,7 +49,13 @@ _KEY_TABLES = (_REFERENCE_INT_KEYS, _SIM_INT_KEYS, _SIM_FLOAT_KEYS,
 _RESERVED = {"engine", "mesh_devices", "msg_shards", "sweep_file",
              "sweep_results", "sweep_max_batch", "sweep_pad_peers",
              "sweep_target", "checkpoint_every", "checkpoint_dir",
-             "checkpoint_resume", "backend", "local_ip", "local_port"}
+             "checkpoint_resume", "backend", "local_ip", "local_port",
+             # serving plane: how the SERVER runs, never what one
+             # scenario simulates (serve/scheduler.py resolves request
+             # dicts through this same table)
+             "serve", "serve_slots", "serve_queue_max",
+             "serve_max_buckets", "serve_chunk", "serve_rounds",
+             "serve_target", "serve_results"}
 
 
 def _attr_for(key: str) -> str | None:

@@ -1,0 +1,38 @@
+"""Gossip-sim-as-a-service: a resident continuous-batching server over
+the fleet engine.
+
+The reference protocol's defining move is *admission into a running
+system* — a peer registers with a seed node and joins gossip rounds
+already in flight (SURVEY.md, seed/membership layer).  The fleet engine
+(PR 4) had the opposite shape: batch-offline, resolve a JSONL sweep,
+run, exit.  This package gives the simulator the reference's shape at
+serving scale, borrowing LLM-serving continuous batching:
+
+* scenarios arrive as the SAME JSONL-line config dicts ``fleet/spec.py``
+  already resolves — over a socket (:mod:`serve.server`, the
+  transport/socket_transport.py wire) or in-process
+  (:class:`serve.service.GossipService`, the ``wrapper.Peer``-style
+  facade: ``submit()/result()/drain()``);
+* a scheduler admits each request into a compatible RESIDENT bucket at
+  a round-boundary (``fleet/packer.py``'s compiled-program signature
+  routes it, so admission never recompiles), waits for a slot freed by
+  convergence masking, and opens a new bucket only on signature miss —
+  with a bounded queue and explicit reject-with-reason backpressure;
+* between chunks the driver scatters admitted scenarios' state/seed/
+  srcs into ``done`` slots (``FleetBucket.admit_into``: donated
+  buffers, admissions staged while the previous chunk still runs);
+* every served scenario stays **bitwise-identical to its solo run**
+  regardless of what was admitted or retired around it
+  (tests/test_serve.py), and per-scenario latency is accounted
+  enqueue→admit→converge→result with p50/p99 in ``stats()``.
+
+docs/ARCHITECTURE.md "The serving seam" has the admission rules and
+why the bitwise contract holds.
+"""
+
+from p2p_gossipprotocol_tpu.serve.scheduler import (Request, Scheduler,
+                                                    ServeReject)
+from p2p_gossipprotocol_tpu.serve.service import GossipService, ServeBucket
+
+__all__ = ["GossipService", "Request", "Scheduler", "ServeBucket",
+           "ServeReject"]

@@ -1,0 +1,220 @@
+"""Admission control for the serving plane.
+
+A request is one JSONL-line config dict — the exact override surface
+``fleet/spec.py`` resolves for offline sweeps — and admission control
+answers three questions about it:
+
+* **is it runnable?**  The scenario resolves through the same
+  ``apply_overrides`` + ``AlignedSimulator.from_config`` path the sweep
+  takes, at submit time, so a typo'd key or an impossible config is a
+  named rejection at the door, never a mid-serve trace error;
+* **where does it run?**  ``fleet/packer.py``'s compiled-program
+  signature routes it: a resident bucket with the same signature and a
+  free slot admits it with zero recompilation; a signature miss opens a
+  new bucket (up to ``serve_max_buckets``); otherwise it waits;
+* **may it wait?**  The queue is bounded (``serve_queue_max``); a full
+  queue rejects with an explicit reason — backpressure the client can
+  see, not an unbounded buffer that hides overload until OOM.
+
+Latency is accounted per request at the four protocol instants the
+issue names — enqueue, admit, converge, result — all
+``time.perf_counter`` so intervals are monotonic.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from p2p_gossipprotocol_tpu.config import ConfigError
+from p2p_gossipprotocol_tpu.fleet.packer import bucket_signature
+from p2p_gossipprotocol_tpu.fleet.spec import ScenarioSpec, build_scenarios
+
+
+class ServeReject(Exception):
+    """A request the server will not take, with the reason clients see
+    on the wire (``rejected`` + ``reason``)."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+#: request lifecycle states, in order
+QUEUED, RUNNING, DONE, FAILED = "queued", "running", "done", "failed"
+
+
+@dataclass
+class Request:
+    """One admitted-or-queued scenario and its latency ledger."""
+
+    rid: int
+    overrides: dict
+    spec: ScenarioSpec
+    signature: tuple
+    status: str = QUEUED
+    #: perf_counter stamps of the four accounting instants
+    t_enqueue: float = 0.0
+    t_admit: float | None = None
+    t_converge: float | None = None
+    t_result: float | None = None
+    row: dict | None = None
+    result: object | None = None       # sim.SimResult once served
+    done_event: threading.Event = field(default_factory=threading.Event,
+                                        repr=False)
+
+    def latency_ms(self) -> dict:
+        """The row's latency columns (admission-to-result is the
+        headline; queue/serve split it)."""
+        out = {}
+        if self.t_admit is not None:
+            out["queue_ms"] = round((self.t_admit - self.t_enqueue)
+                                    * 1e3, 3)
+        if self.t_result is not None:
+            out["latency_ms"] = round((self.t_result - self.t_enqueue)
+                                      * 1e3, 3)
+            if self.t_admit is not None:
+                out["serve_ms"] = round((self.t_result - self.t_admit)
+                                        * 1e3, 3)
+        return out
+
+
+def resolve_request(base_cfg, overrides: dict, rid: int,
+                    n_peers: int | None = None,
+                    pad_peers: bool = True) -> ScenarioSpec:
+    """One request dict -> the exact solo scenario the sweep layer would
+    build for the same line (same tables, same clamps machinery, same
+    padding record) — which is what makes the serving plane's bitwise
+    contract the fleet's, not a new one.  Raises :class:`ServeReject`
+    with the resolution error as the reason."""
+    try:
+        spec = build_scenarios(base_cfg, [overrides], n_peers=n_peers,
+                               pad_peers=pad_peers)[0]
+    except ConfigError as e:
+        raise ServeReject(f"bad scenario: {e.message}") from e
+    # build_scenarios numbers specs by sweep position; a served request
+    # is identified by its rid across resumes
+    spec.index = rid
+    return spec
+
+
+class Scheduler:
+    """Bounded FIFO admission queue + the request registry.
+
+    Thread-safe: ``submit`` runs on client threads (socket handlers,
+    facade callers), everything else on the serving loop.  Routing —
+    which bucket a queued request lands in — lives with the loop that
+    owns the buckets (:class:`serve.service.GossipService`); this class
+    owns admission *policy* (resolve-or-reject, bound-or-reject) and
+    the ledger the ``/stats`` response reads."""
+
+    def __init__(self, base_cfg, queue_max: int,
+                 n_peers: int | None = None, pad_peers: bool = True,
+                 next_rid: int = 0):
+        self.base_cfg = base_cfg
+        self.queue_max = queue_max
+        self.n_peers = n_peers
+        self.pad_peers = pad_peers
+        self.requests: dict[int, Request] = {}
+        self.queue: deque[int] = deque()
+        self.n_rejected = 0
+        self._next_rid = next_rid
+        self._lock = threading.Lock()
+        self._accepting = True
+
+    # -- client side ----------------------------------------------------
+    def submit(self, overrides: dict, rid: int | None = None) -> Request:
+        """Resolve + enqueue one request; raises :class:`ServeReject`
+        (draining server, full queue, unresolvable scenario).  ``rid``
+        is only passed by resume re-hydration, which must keep the
+        original ids."""
+        with self._lock:
+            if not self._accepting:
+                self.n_rejected += 1
+                raise ServeReject("server is draining (no new work)")
+            if len(self.queue) >= self.queue_max:
+                self.n_rejected += 1
+                raise ServeReject(
+                    f"queue full ({self.queue_max} waiting; retry "
+                    "later or raise serve_queue_max)")
+            if rid is None:
+                rid = self._next_rid
+        try:
+            spec = resolve_request(self.base_cfg,
+                                   copy.deepcopy(overrides), rid,
+                                   n_peers=self.n_peers,
+                                   pad_peers=self.pad_peers)
+        except ServeReject:
+            with self._lock:
+                self.n_rejected += 1
+            raise
+        req = Request(rid=rid, overrides=dict(overrides), spec=spec,
+                      signature=bucket_signature(spec.sim),
+                      t_enqueue=time.perf_counter())
+        with self._lock:
+            # re-check the bound under the lock (resolution dropped it)
+            if len(self.queue) >= self.queue_max:
+                self.n_rejected += 1
+                raise ServeReject(
+                    f"queue full ({self.queue_max} waiting; retry "
+                    "later or raise serve_queue_max)")
+            self._next_rid = max(self._next_rid, rid + 1)
+            self.requests[rid] = req
+            self.queue.append(rid)
+        return req
+
+    def stop_accepting(self) -> None:
+        with self._lock:
+            self._accepting = False
+
+    # -- serving-loop side ---------------------------------------------
+    def queued(self) -> list[Request]:
+        """Snapshot of waiting requests in FIFO order."""
+        with self._lock:
+            return [self.requests[r] for r in self.queue]
+
+    def mark_admitted(self, req: Request) -> None:
+        with self._lock:
+            try:
+                self.queue.remove(req.rid)
+            except ValueError:
+                pass
+            req.status = RUNNING
+            req.t_admit = time.perf_counter()
+
+    def finish(self, req: Request, row: dict, result=None,
+               failed: bool = False) -> None:
+        req.t_result = time.perf_counter()
+        req.row = {**row, **req.latency_ms()}
+        req.result = result
+        req.status = FAILED if failed else DONE
+        req.done_event.set()
+
+    # -- ledger ---------------------------------------------------------
+    def stats(self) -> dict:
+        """The ``/stats`` payload: population counts + the p50/p99
+        admission-to-result latency over completed requests (the
+        serving plane's headline metric)."""
+        import numpy as np
+
+        with self._lock:
+            reqs = list(self.requests.values())
+            n_queued = len(self.queue)
+        lat = [r.t_result - r.t_enqueue for r in reqs
+               if r.status == DONE and r.t_result is not None]
+        out = {
+            "submitted": len(reqs),
+            "rejected": self.n_rejected,
+            "queued": n_queued,
+            "running": sum(1 for r in reqs if r.status == RUNNING),
+            "done": sum(1 for r in reqs if r.status == DONE),
+            "failed": sum(1 for r in reqs if r.status == FAILED),
+        }
+        if lat:
+            a = np.asarray(lat) * 1e3
+            out["p50_ms"] = round(float(np.percentile(a, 50)), 3)
+            out["p99_ms"] = round(float(np.percentile(a, 99)), 3)
+        return out

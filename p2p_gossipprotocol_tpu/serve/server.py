@@ -1,0 +1,220 @@
+"""The wire surface of the serving plane: submit over TCP.
+
+One listening socket (``transport/socket_transport.py``'s
+SocketTransport — the same plumbing the socket-mode peer/seed runtime
+uses, same ``wire_format`` config: reference-compatible unframed JSON
+or length-framed), one handler thread per connection, JSON documents
+both ways:
+
+===========  =====================================  ====================
+request      fields                                 response
+===========  =====================================  ====================
+``submit``   ``scenario`` (a JSONL-line config      ``accepted`` (id) or
+             dict — the sweep override surface)     ``rejected`` (reason)
+``result``   ``id``, optional ``timeout`` (s)       ``result`` (row) /
+                                                    ``pending`` / error
+``stats``    —                                      ``stats`` (p50/p99
+                                                    latency + occupancy)
+``drain``    —                                      ``drained`` (stats),
+                                                    then the server stops
+===========  =====================================  ====================
+
+The server is a thin adapter: every decision (admission, backpressure,
+latency accounting, salvage) lives in :class:`serve.service
+.GossipService`; a malformed document answers an ``error`` object
+instead of killing the handler.  :class:`ServeClient` is the matching
+caller — the bench/benchmark drivers and the tests speak through it.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from p2p_gossipprotocol_tpu.serve.scheduler import ServeReject
+from p2p_gossipprotocol_tpu.transport.socket_transport import (
+    WIRE_FORMATS, SocketTransport)
+
+
+class ServeServer:
+    """Accept loop + per-connection handlers over a GossipService."""
+
+    def __init__(self, service, ip: str, port: int,
+                 wire_format: str = "json", log=None):
+        if wire_format not in WIRE_FORMATS:
+            raise ValueError(f"unknown wire_format: {wire_format}")
+        self.service = service
+        self.transport = SocketTransport(ip, port)
+        self.send, self.stream_cls = WIRE_FORMATS[wire_format]
+        self.log = log
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._accept_thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------------
+    @property
+    def port(self) -> int:
+        """The bound port (resolves a port-0 ephemeral bind)."""
+        if self.transport.listener is not None:
+            return self.transport.listener.getsockname()[1]
+        return self.transport.port
+
+    def start(self) -> "ServeServer":
+        self.transport.start()
+        self.service.start()
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+        if self.log:
+            self.log(f"[serve] listening on {self.transport.ip}:"
+                     f"{self.port}")
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.transport.stop()
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+
+    def wait(self, poll_s: float = 0.1) -> None:
+        """Block until a ``drain`` request (or stop()) ends the server."""
+        while not self._stop.is_set():
+            self._stop.wait(poll_s)
+
+    # ------------------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            conn, _addr = self.transport.accept(timeout=0.25)
+            if conn is None:
+                continue
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _handle(self, conn: socket.socket) -> None:
+        stream = self.stream_cls(conn)
+        conn.settimeout(0.5)
+        try:
+            while not self._stop.is_set():
+                docs = stream.recv_objects()
+                if docs is None:
+                    return                       # client hung up
+                for doc in docs:
+                    if not self._dispatch(conn, doc):
+                        return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _reply(self, conn, obj: dict) -> None:
+        try:
+            self.send(conn, obj)
+        except OSError:
+            pass
+
+    def _dispatch(self, conn, doc) -> bool:
+        """Handle one document; returns False when the connection (or
+        the whole server, on drain) should end."""
+        if not isinstance(doc, dict):
+            self._reply(conn, {"type": "error",
+                               "reason": "requests are JSON objects"})
+            return True
+        op = doc.get("type")
+        if op == "submit":
+            scenario = doc.get("scenario")
+            if not isinstance(scenario, dict):
+                self._reply(conn, {"type": "rejected",
+                                   "reason": "submit needs a "
+                                             "'scenario' object"})
+                return True
+            try:
+                rid = self.service.submit(scenario)
+            except ServeReject as e:
+                self._reply(conn, {"type": "rejected",
+                                   "reason": e.reason})
+                return True
+            self._reply(conn, {"type": "accepted", "id": rid})
+        elif op == "result":
+            rid = doc.get("id")
+            try:
+                row = self.service.result(
+                    int(rid), timeout=float(doc.get("timeout", 600)))
+            except KeyError:
+                self._reply(conn, {"type": "error",
+                                   "reason": f"unknown request id "
+                                             f"{rid}"})
+                return True
+            except TimeoutError:
+                self._reply(conn, {"type": "pending", "id": int(rid)})
+                return True
+            except Exception as e:  # noqa: BLE001 — loop failure, surfaced
+                self._reply(conn, {"type": "error",
+                                   "reason": f"{type(e).__name__}: "
+                                             f"{e}"})
+                return True
+            self._reply(conn, {"type": "result", "id": int(rid),
+                               "row": row})
+        elif op == "stats":
+            self._reply(conn, {"type": "stats",
+                               **self.service.stats()})
+        elif op == "drain":
+            stats = self.service.drain()
+            self._reply(conn, {"type": "drained", **stats})
+            self._stop.set()
+            return False
+        else:
+            self._reply(conn, {"type": "error",
+                               "reason": f"unknown request type "
+                                         f"{op!r}"})
+        return True
+
+
+class ServeClient:
+    """Caller half of the protocol (tests, bench, load drivers)."""
+
+    def __init__(self, ip: str, port: int, wire_format: str = "json",
+                 timeout: float = 10.0):
+        self.sock = socket.create_connection((ip, port), timeout=timeout)
+        self.send, stream_cls = WIRE_FORMATS[wire_format]
+        self.stream = stream_cls(self.sock)
+
+    def _rpc(self, obj: dict) -> dict:
+        self.send(self.sock, obj)
+        while True:
+            docs = self.stream.recv_objects()
+            if docs is None:
+                raise ConnectionError("server closed the connection")
+            if docs:
+                return docs[0]
+
+    def submit(self, scenario: dict) -> int:
+        """Submit one scenario; returns the request id or raises
+        :class:`ServeReject` with the server's reason."""
+        resp = self._rpc({"type": "submit", "scenario": scenario})
+        if resp.get("type") == "accepted":
+            return int(resp["id"])
+        raise ServeReject(resp.get("reason", "rejected"))
+
+    def result(self, rid: int, timeout: float = 600.0) -> dict:
+        resp = self._rpc({"type": "result", "id": rid,
+                          "timeout": timeout})
+        if resp.get("type") == "result":
+            return resp["row"]
+        if resp.get("type") == "pending":
+            raise TimeoutError(f"request {rid} still pending")
+        raise RuntimeError(resp.get("reason", str(resp)))
+
+    def stats(self) -> dict:
+        return self._rpc({"type": "stats"})
+
+    def drain(self) -> dict:
+        return self._rpc({"type": "drain"})
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass

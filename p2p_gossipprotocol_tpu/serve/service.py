@@ -1,0 +1,611 @@
+"""The resident serving loop: hot buckets, slot-swap admission, drain.
+
+:class:`GossipService` is the ``wrapper.Peer``-style facade —
+``submit()/result()/drain()`` — over a background serving thread that
+keeps :class:`ServeBucket`\\ s resident on-device and admits/retires
+scenarios at chunk (round) boundaries:
+
+* **admission** routes on ``fleet/packer.py``'s compiled-program
+  signature: a matching resident bucket with a free slot takes the
+  scenario as a pure array scatter (``FleetBucket.admit_into`` — the
+  one chunk program is never retraced, asserted by
+  ``FleetBucket.trace_count``); a signature miss opens a new bucket (up
+  to ``serve_max_buckets``, evicting an all-idle one first);
+* **execution** runs each live bucket one ``serve_chunk``-round
+  compiled chunk at a time.  Admission payloads for still-queued
+  requests are staged (host→HBM transfers dispatched) while the chunk
+  executes, so the next admission scatter overlaps the current chunk's
+  result readback — the double-buffered staging the batch-offline
+  driver never needed;
+* **retirement** reuses convergence masking: the chunk's on-device
+  ``done`` mask freezes a converged scenario at its exact round, the
+  loop truncates its history there and frees the slot.  A scenario
+  that exhausts ``serve_rounds`` retires unconverged (and is marked
+  done so its slot frees) — never silently served forever.
+
+The hard contract (tests/test_serve.py): every served scenario —
+including one admitted mid-flight into a slot another scenario retired
+from — is **bitwise-identical to its solo AlignedSimulator run**.  It
+holds because admission only ever writes the scenario's own slot of the
+batch (its exact solo init state, overlay, seed, and source table), the
+vmapped round is per-slot independent (the PR 4 fleet contract), and
+retirement freezes before reuse.
+
+Drain/salvage (the preemption contract, extended to a server): SIGTERM
+mid-serve persists every resident bucket through the elastic-checkpoint
+discipline (CRC'd npz + atomic manifest, ``utils/checkpoint.py``'s
+torn-write rules) plus the queue itself (request overrides + ids), the
+CLI exits 75, and a restarted ``--serve --resume`` re-hydrates the
+queue and completes every previously admitted scenario bitwise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from p2p_gossipprotocol_tpu.fleet.engine import (METRIC_DTYPES,
+                                                 METRIC_KEYS, FleetBucket,
+                                                 _unstack_topology)
+from p2p_gossipprotocol_tpu.serve.scheduler import (DONE, QUEUED, RUNNING,
+                                                    Request, Scheduler,
+                                                    resolve_request)
+
+#: serve manifest schema (the sweep manifest's sibling; fingerprint /
+#: atomic-write / CRC machinery shared from utils.checkpoint)
+SERVE_SCHEMA = 1
+
+_STATE_LEAVES = ("seen_w", "frontier_w", "alive_b", "byz_w", "key",
+                 "round")
+
+
+@dataclass
+class Occupant:
+    """One live slot: the request it serves and its per-slot ledger.
+    ``rounds`` counts rounds since ADMISSION (the scenario's own round
+    counter — slot time, not bucket time), ``converged`` is its
+    1-indexed convergence round or -1, ``hist`` accumulates the slot's
+    column of each chunk's metric block."""
+
+    req: Request
+    rounds: int = 0
+    converged: int = -1
+    hist: dict = field(default_factory=lambda: {
+        k: [] for k in METRIC_KEYS})
+
+    @property
+    def spec(self):
+        return self.req.spec
+
+
+class ServeBucket:
+    """A resident, slot-swappable bucket: one compiled chunk program
+    serving a rotating population of signature-identical scenarios."""
+
+    def __init__(self, template_spec, slots: int, chunk: int,
+                 target: float):
+        from p2p_gossipprotocol_tpu.fleet.packer import bucket_signature
+
+        self.template_spec = template_spec
+        self.fleet = FleetBucket.for_serving(template_spec.sim, slots)
+        self.slots = slots
+        self.chunk = chunk
+        self.target = target
+        self.signature = bucket_signature(template_spec.sim)
+        self.state, self.topo, self.done = self.fleet.init_idle()
+        self.seeds = self.fleet._seeds
+        self.srcs = self.fleet._srcs
+        self.occupants: list[Occupant | None] = [None] * slots
+
+    # ------------------------------------------------------------------
+    def free_slots(self) -> list[int]:
+        return [i for i, o in enumerate(self.occupants) if o is None]
+
+    def live(self) -> bool:
+        return any(o is not None for o in self.occupants)
+
+    # ------------------------------------------------------------------
+    def admit(self, req: Request, slot: int | None = None) -> int:
+        """Scatter ``req``'s scenario into a free slot (round-boundary
+        only — the loop calls this between chunks).  Uses the payload
+        staged during the previous chunk when one exists."""
+        if req.signature != self.signature:
+            raise ValueError("scheduler routed a request to a bucket "
+                             "with a different program signature")
+        if slot is None:
+            free = self.free_slots()
+            if not free:
+                raise ValueError("admit() on a full bucket")
+            slot = free[0]
+        payload = getattr(req, "_staged_payload", None)
+        if payload is None:
+            payload = self.fleet.admit_args(req.spec.sim)
+        else:
+            req._staged_payload = None
+        (self.state, self.topo, self.done, self.seeds,
+         self.srcs) = self.fleet.admit_into(
+            self.state, self.topo, self.done, self.seeds, self.srcs,
+            slot, payload=payload)
+        self.occupants[slot] = Occupant(req=req)
+        return slot
+
+    def stage(self, req: Request) -> None:
+        """Pre-build ``req``'s admission payload (init state, overlay
+        leaves, seed, srcs — host work + async device transfers) while
+        a chunk is still executing, so the scatter at the next boundary
+        is purely on-device."""
+        if getattr(req, "_staged_payload", None) is None:
+            req._staged_payload = self.fleet.admit_args(req.spec.sim)
+
+    # ------------------------------------------------------------------
+    def dispatch(self):
+        """Run one chunk (async — the returned metric arrays are
+        futures until device_get)."""
+        fn = self.fleet._chunk_fn(self.chunk, self.target)
+        (self.state, self.topo, self.done, ys, dhist) = fn(
+            self.state, self.topo, self.done, self.seeds, self.srcs)
+        return ys, dhist
+
+    def collect(self, ys, dhist, max_rounds: int):
+        """Read back one chunk's metrics and retire finished occupants.
+        Returns ``[(slot, occupant, sim_result), ...]`` for every
+        scenario that converged (its history truncated at its exact
+        convergence round) or hit the ``max_rounds`` cap (unconverged,
+        slot force-frozen)."""
+        from p2p_gossipprotocol_tpu.sim import SimResult
+
+        step = self.chunk
+        ys = {k: np.asarray(jax.device_get(ys[k])) for k in METRIC_KEYS}
+        dh = np.asarray(jax.device_get(dhist))
+        retired = []
+        for s, occ in enumerate(self.occupants):
+            if occ is None:
+                continue
+            for k in METRIC_KEYS:
+                occ.hist[k].append(ys[k][:, s])
+            if occ.converged < 0:
+                hits = np.nonzero(dh[:, s])[0]
+                if hits.size:
+                    occ.converged = occ.rounds + int(hits[0]) + 1
+            occ.rounds += step
+            if occ.converged > 0 or occ.rounds >= max_rounds:
+                if occ.converged < 0:
+                    # cap-retired: freeze the slot so reuse is safe
+                    self.done = self.fleet.mark_done(self.done, s)
+                retired.append((s, occ, self._extract(s, occ)))
+                self.occupants[s] = None
+        return retired
+
+    def _extract(self, slot: int, occ: Occupant):
+        """The occupant's SimResult — its slot's state/topology slice
+        and its history truncated at its own rounds-run count, the
+        exact shape a solo ``sim.run(rounds_run)`` returns."""
+        from p2p_gossipprotocol_tpu.sim import SimResult
+
+        r_i = occ.converged if occ.converged > 0 else occ.rounds
+        st_i = jax.tree.map(lambda x: x[slot], self.state)
+        tp_i = _unstack_topology(self.topo, slot, occ.spec.sim.topo)
+        hist = {k: np.concatenate(occ.hist[k])[:r_i].astype(
+            METRIC_DTYPES[k], copy=False) for k in METRIC_KEYS}
+        wall = time.perf_counter() - (occ.req.t_admit
+                                      or occ.req.t_enqueue)
+        return SimResult(state=st_i, topo=tp_i, wall_s=wall, **hist)
+
+    def rounds_run_of(self, occ: Occupant) -> int:
+        return occ.converged if occ.converged > 0 else occ.rounds
+
+
+class GossipService:
+    """submit()/result()/drain() facade over the resident serving loop
+    (the ``wrapper.Peer`` lifecycle shape, serving many scenarios
+    instead of embodying one peer)."""
+
+    def __init__(self, cfg, n_peers: int | None = None, *,
+                 slots: int | None = None, queue_max: int | None = None,
+                 max_buckets: int | None = None, chunk: int | None = None,
+                 target: float | None = None, rounds: int | None = None,
+                 checkpoint_dir: str | None = None,
+                 results_path: str | None = None, resume: bool = False,
+                 log=None):
+        from p2p_gossipprotocol_tpu.engines import probe_backend
+
+        probe_backend()
+        self.cfg = cfg
+        self.n_peers = n_peers
+        self.slots = slots or cfg.serve_slots
+        self.max_buckets = max_buckets or cfg.serve_max_buckets
+        self.chunk = chunk or cfg.serve_chunk
+        self.target = cfg.serve_target if target is None else target
+        self.rounds = rounds or cfg.serve_rounds or cfg.rounds or 64
+        self.checkpoint_dir = checkpoint_dir or cfg.checkpoint_dir or None
+        self.results_path = results_path or cfg.serve_results or None
+        self.log = log
+        self.scheduler = Scheduler(
+            cfg, queue_max or cfg.serve_queue_max, n_peers=n_peers,
+            pad_peers=bool(cfg.sweep_pad_peers))
+        self.buckets: list[ServeBucket] = []
+        self.salvaged = False
+        self._error: Exception | None = None
+        self._thread: threading.Thread | None = None
+        self._draining = threading.Event()
+        self._salvage = threading.Event()
+        self._wake = threading.Event()
+        if resume:
+            self._resume()
+
+    # -- fingerprint ---------------------------------------------------
+    def _fingerprint(self) -> str:
+        """The BASE config's trajectory identity: every request is base
+        + overrides, so a drifted base invalidates the whole serve
+        checkpoint (the per-request overrides ride the manifest
+        verbatim and re-resolve against the verified base)."""
+        from p2p_gossipprotocol_tpu.engines import config_keys
+        from p2p_gossipprotocol_tpu.utils.checkpoint import \
+            config_fingerprint
+
+        return config_fingerprint(
+            {"serve_base": config_keys(self.cfg, n_peers=self.n_peers)})
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "GossipService":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def is_running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    # -- client surface -------------------------------------------------
+    def submit(self, overrides: dict) -> int:
+        """Enqueue one scenario (a JSONL-line config dict); returns its
+        request id.  Raises :class:`ServeReject` — full queue, draining
+        server, unresolvable scenario — the explicit-backpressure
+        contract."""
+        req = self.scheduler.submit(overrides)
+        self._wake.set()
+        return req.rid
+
+    def result(self, rid: int, timeout: float | None = None) -> dict:
+        """Block until request ``rid`` completes; returns its results
+        row.  Raises KeyError for an unknown id, TimeoutError on
+        timeout, and re-raises a serving-loop failure."""
+        req = self.scheduler.requests[rid]
+        if not req.done_event.wait(timeout):
+            raise TimeoutError(f"request {rid} not done within "
+                               f"{timeout}s")
+        if self._error is not None and req.row is None:
+            raise self._error
+        return req.row
+
+    def sim_result(self, rid: int):
+        """The served scenario's full SimResult (state + metric
+        history) — the bitwise-parity surface the tests compare against
+        solo runs."""
+        return self.scheduler.requests[rid].result
+
+    def stats(self) -> dict:
+        """The ``/stats`` payload: scheduler ledger + resident-bucket
+        occupancy + the zero-recompile counter."""
+        out = self.scheduler.stats()
+        out["buckets"] = len(self.buckets)
+        out["slots"] = sum(b.slots for b in self.buckets)
+        out["slots_free"] = sum(len(b.free_slots())
+                                for b in self.buckets)
+        out["chunk_retraces"] = sum(b.fleet.trace_count
+                                    for b in self.buckets)
+        return out
+
+    def drain(self, timeout: float | None = None) -> dict:
+        """Stop accepting, serve everything already admitted or queued,
+        stop the loop; returns the final stats."""
+        self.scheduler.stop_accepting()
+        self._draining.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        if self._error is not None:
+            raise self._error
+        return self.stats()
+
+    def salvage(self, timeout: float | None = None) -> dict:
+        """Preemption path: persist every resident bucket + the queue
+        at the next chunk boundary (needs ``checkpoint_dir``), then
+        stop.  The restarted server (``resume=True``) completes every
+        previously admitted scenario bitwise."""
+        if not self.checkpoint_dir:
+            raise ValueError("salvage needs a checkpoint_dir")
+        self.scheduler.stop_accepting()
+        self._salvage.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        if self._error is not None:
+            raise self._error
+        return self.stats()
+
+    # -- the serving loop ----------------------------------------------
+    def _bucket_for(self, req: Request) -> ServeBucket | None:
+        """Routing: same-signature bucket with a free slot, else a new
+        bucket (evicting an all-idle one when at the cap), else None
+        (the request keeps waiting)."""
+        for b in self.buckets:
+            if b.signature == req.signature and b.free_slots():
+                return b
+        if len(self.buckets) >= self.max_buckets:
+            idle = [b for b in self.buckets if not b.live()]
+            if not idle:
+                return None
+            self.buckets.remove(idle[0])
+        b = ServeBucket(req.spec, self.slots, self.chunk, self.target)
+        self.buckets.append(b)
+        if self.log:
+            self.log(f"[serve] opened bucket {len(self.buckets) - 1} "
+                     f"({self.slots} slots) for request {req.rid}")
+        return b
+
+    def _admit_pending(self) -> int:
+        n = 0
+        for req in self.scheduler.queued():
+            b = self._bucket_for(req)
+            if b is None:
+                continue
+            slot = b.admit(req)
+            self.scheduler.mark_admitted(req)
+            n += 1
+            if self.log:
+                self.log(f"[serve] request {req.rid} -> bucket "
+                         f"{self.buckets.index(b)} slot {slot}")
+        return n
+
+    def _stage_pending(self) -> None:
+        """While chunks execute: pre-stage admission payloads for
+        queued requests that already have a destination bucket — the
+        host→HBM half of the next admissions overlaps this chunk's
+        compute and readback."""
+        sigs = {b.signature for b in self.buckets}
+        for req in self.scheduler.queued():
+            if req.signature in sigs:
+                for b in self.buckets:
+                    if b.signature == req.signature:
+                        b.stage(req)
+                        break
+
+    def _finish(self, bucket_id: int, occ: Occupant, res) -> None:
+        req = occ.req
+        req.t_converge = time.perf_counter()
+        spec = occ.spec
+        r_i = len(res.coverage)
+        row = {**spec.row_identity(), "engine": "serve",
+               "request": req.rid, "bucket": bucket_id,
+               "rounds_run": int(r_i),
+               "converged": bool(occ.converged > 0)}
+        if r_i:
+            row["final_coverage"] = float(res.coverage[-1])
+            row["total_deliveries"] = int(round(
+                float(res.deliveries.sum())))
+        if self.target:
+            row[f"rounds_to_{self.target:g}"] = int(
+                res.rounds_to(self.target))
+        self.scheduler.finish(req, row, result=res)
+        if self.results_path:
+            from p2p_gossipprotocol_tpu.fleet.driver import append_rows
+
+            append_rows(self.results_path, [req.row])
+
+    def _loop(self) -> None:
+        try:
+            while True:
+                if self._salvage.is_set():
+                    self._persist_all()
+                    self.salvaged = True
+                    return
+                self._admit_pending()
+                active = [b for b in self.buckets if b.live()]
+                if not active:
+                    if self._draining.is_set() \
+                            and not self.scheduler.queued():
+                        return
+                    self._wake.wait(0.02)
+                    self._wake.clear()
+                    continue
+                for b in active:
+                    ys, dhist = b.dispatch()
+                    # overlap seam: stage the next admissions while the
+                    # chunk executes; collect() below is the sync point
+                    self._stage_pending()
+                    for slot, occ, res in b.collect(ys, dhist,
+                                                    self.rounds):
+                        self._finish(self.buckets.index(b), occ, res)
+        except Exception as e:  # noqa: BLE001 — surface via result()
+            self._error = e
+            for req in list(self.scheduler.requests.values()):
+                if req.status in (RUNNING, QUEUED):
+                    self.scheduler.finish(
+                        req, {"request": req.rid,
+                              "error": f"{type(e).__name__}: {e}"},
+                        failed=True)
+
+    # -- salvage / resume ----------------------------------------------
+    def _manifest_path(self) -> str:
+        return os.path.join(self.checkpoint_dir, "serve_manifest.json")
+
+    def _bucket_path(self, b: int) -> str:
+        return os.path.join(self.checkpoint_dir, f"serve_bucket_{b}.npz")
+
+    def _persist_all(self) -> None:
+        """Persist the whole serving state at a chunk boundary: the
+        queue (request ids + overrides, FIFO order), completed rows,
+        and every live bucket's CRC'd snapshot — the sweep driver's
+        torn-write discipline (payload lands, then the manifest commits
+        atomically)."""
+        from p2p_gossipprotocol_tpu.utils.checkpoint import (_crc_entry,
+                                                             _write_atomic)
+
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        manifest = {
+            "schema": SERVE_SCHEMA, "kind": "serve",
+            "fingerprint": self._fingerprint(),
+            "next_rid": self.scheduler._next_rid,
+            "queued": [{"rid": r.rid, "overrides": r.overrides}
+                       for r in self.scheduler.queued()],
+            "done": {str(r.rid): r.row
+                     for r in self.scheduler.requests.values()
+                     if r.status == DONE and r.row is not None},
+            "buckets": [],
+        }
+        for bi, b in enumerate(self.buckets):
+            if not b.live():
+                continue
+            payload = {f"state/{k}": np.asarray(
+                jax.device_get(getattr(b.state, k)))
+                for k in _STATE_LEAVES}
+            if b.state.strikes is not None:
+                payload["state/strikes"] = np.asarray(
+                    jax.device_get(b.state.strikes))
+            payload["topo/colidx"] = np.asarray(
+                jax.device_get(b.topo.colidx))
+            payload["mask/done"] = np.asarray(jax.device_get(b.done))
+            occs = {}
+            for s, occ in enumerate(b.occupants):
+                if occ is None:
+                    continue
+                occs[str(s)] = {"rid": occ.req.rid,
+                                "overrides": occ.req.overrides,
+                                "rounds": occ.rounds,
+                                "converged": occ.converged}
+                for k in METRIC_KEYS:
+                    payload[f"hist/{s}/{k}"] = (
+                        np.concatenate(occ.hist[k])
+                        if occ.hist[k]
+                        else np.zeros((0,), METRIC_DTYPES[k]))
+            path = self._bucket_path(len(manifest["buckets"]))
+            tmp = path + ".tmp.npz"
+            np.savez(tmp, **payload)
+            os.replace(tmp, path)
+            manifest["buckets"].append({
+                "slots": b.slots,
+                "template": b.template_spec.overrides,
+                "occupants": occs,
+                "leaves": {k: _crc_entry(v)
+                           for k, v in payload.items()},
+            })
+        _write_atomic(self._manifest_path(),
+                      json.dumps(manifest, sort_keys=True))
+        if self.log:
+            n_live = sum(len(e["occupants"])
+                         for e in manifest["buckets"])
+            self.log(f"[serve] salvaged {len(manifest['buckets'])} "
+                     f"bucket(s), {n_live} in-flight scenario(s), "
+                     f"{len(manifest['queued'])} queued")
+
+    def _resume(self) -> None:
+        """Re-hydrate a salvaged server: completed rows return as done
+        requests, in-flight buckets restore CRC-verified (occupant
+        worlds re-admitted from their re-resolved solo sims, then the
+        snapshot's state/colidx/done overwrite them — the sweep
+        driver's restore split: statics rebuild deterministically, only
+        mutated arrays carry history), and the queue re-submits in its
+        original FIFO order under the original request ids."""
+        from p2p_gossipprotocol_tpu.utils.checkpoint import (
+            CorruptCheckpoint, FingerprintMismatch, _crc_entry,
+            read_manifest)
+
+        if not self.checkpoint_dir:
+            raise ValueError("resume needs a checkpoint_dir")
+        manifest = read_manifest(self._manifest_path(),
+                                 schema_max=SERVE_SCHEMA,
+                                 what="serve checkpoint")
+        fp = self._fingerprint()
+        if manifest.get("fingerprint") != fp:
+            raise FingerprintMismatch(
+                "serve checkpoint was written under fingerprint "
+                f"{manifest.get('fingerprint')}, this server "
+                f"fingerprints as {fp} — resume with the original base "
+                "config, or point --checkpoint-dir at a fresh "
+                "directory")
+        self.scheduler._next_rid = int(manifest.get("next_rid", 0))
+        # completed rows come back as done requests (result() replays)
+        for rid_s, row in manifest.get("done", {}).items():
+            req = Request(rid=int(rid_s), overrides={}, spec=None,
+                          signature=None, status=DONE,
+                          t_enqueue=time.perf_counter())
+            req.row = row
+            req.done_event.set()
+            self.scheduler.requests[int(rid_s)] = req
+        from p2p_gossipprotocol_tpu.aligned import AlignedState
+
+        for bi, entry in enumerate(manifest.get("buckets", [])):
+            path = self._bucket_path(bi)
+            try:
+                with np.load(path) as m:
+                    payload = {k: m[k] for k in m.files}
+            except Exception as e:  # noqa: BLE001 — any unreadable snapshot
+                raise CorruptCheckpoint(
+                    f"serve bucket {bi} snapshot is unreadable "
+                    f"({type(e).__name__}: {e})") from e
+            for name, info in entry["leaves"].items():
+                if name not in payload:
+                    raise CorruptCheckpoint(
+                        f"serve bucket {bi} snapshot is missing leaf "
+                        f"{name!r}")
+                if _crc_entry(payload[name])["crc32"] != info["crc32"]:
+                    raise CorruptCheckpoint(
+                        f"CRC mismatch in serve bucket {bi} leaf "
+                        f"{name!r}")
+            tmpl = resolve_request(self.cfg, entry["template"], rid=-1,
+                                   n_peers=self.n_peers,
+                                   pad_peers=bool(
+                                       self.cfg.sweep_pad_peers))
+            b = ServeBucket(tmpl, int(entry["slots"]), self.chunk,
+                            self.target)
+            for slot_s, occ_e in entry["occupants"].items():
+                slot, rid = int(slot_s), int(occ_e["rid"])
+                spec = resolve_request(
+                    self.cfg, occ_e["overrides"], rid,
+                    n_peers=self.n_peers,
+                    pad_peers=bool(self.cfg.sweep_pad_peers))
+                from p2p_gossipprotocol_tpu.fleet.packer import \
+                    bucket_signature
+
+                req = Request(rid=rid, overrides=dict(occ_e["overrides"]),
+                              spec=spec,
+                              signature=bucket_signature(spec.sim),
+                              status=RUNNING,
+                              t_enqueue=time.perf_counter())
+                req.t_admit = req.t_enqueue
+                self.scheduler.requests[rid] = req
+                b.admit(req, slot=slot)
+                occ = b.occupants[slot]
+                occ.rounds = int(occ_e["rounds"])
+                occ.converged = int(occ_e["converged"])
+                for k in METRIC_KEYS:
+                    h = payload[f"hist/{slot}/{k}"]
+                    occ.hist[k] = [h] if len(h) else []
+            # the snapshot's mutated arrays win over the re-admitted
+            # init worlds: state leaves wholesale, rewired lanes, done
+            b.state = AlignedState(
+                **{k: jnp.asarray(payload[f"state/{k}"])
+                   for k in _STATE_LEAVES},
+                strikes=(jnp.asarray(payload["state/strikes"])
+                         if "state/strikes" in payload else None))
+            b.topo = b.topo.replace(
+                colidx=jnp.asarray(payload["topo/colidx"]))
+            b.done = jnp.asarray(payload["mask/done"])
+            self.buckets.append(b)
+        for item in manifest.get("queued", []):
+            self.scheduler.submit(item["overrides"],
+                                  rid=int(item["rid"]))
+        if self.log:
+            self.log(f"[serve] resumed {len(self.buckets)} bucket(s), "
+                     f"{len(manifest.get('queued', []))} queued "
+                     "request(s) re-hydrated")

@@ -55,6 +55,16 @@ class Peer:
             )
         else:
             self.node = None
+            if getattr(cfg, "serve", 0):
+                # the facade is ONE peer's lifecycle; a resident
+                # many-scenario server has its own facade with the
+                # submit/result/drain surface the protocol needs
+                raise ValueError(
+                    "serve=1 (the resident gossip-sim server) is not "
+                    "reachable through the wrapper.Peer facade — use "
+                    "the CLI's --serve, or "
+                    "p2p_gossipprotocol_tpu.serve.GossipService "
+                    "(submit()/result()/drain()) directly")
             if getattr(cfg, "supervise", 0):
                 # supervision launches and kills WORKER PROCESSES; the
                 # facade is one in-process peer — routing it here would

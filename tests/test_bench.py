@@ -12,6 +12,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 BENCH = "/root/repo/bench.py"
 
 _BASE_ENV = {
@@ -57,6 +59,9 @@ def test_bench_cpu_run_is_labeled_and_complete():
               / rec["value"] / 1e9 / rec["roof_gb_s"])
     assert abs(rec["roofline_frac"] - expect) <= 1e-4 + 0.01 * expect
     assert rec["achieved_gb_s"] is not None
+    # round-12 serving columns appear ONLY under GOSSIP_BENCH_SERVE —
+    # headline rows stay comparable across rounds
+    assert "serve_qps" not in rec and "serve_p50_ms" not in rec
 
 
 def test_bench_falls_back_to_cpu_when_backend_init_fails():
@@ -146,6 +151,28 @@ def test_bench_hier_tier_columns():
     assert rec["ici_bytes_round"] > rec["dcn_bytes_round"] > 0
     assert abs(rec["ici_gb"] - rec["ici_bytes_round"] / 1e9) <= 1e-6
     assert abs(rec["dcn_gb"] - rec["dcn_bytes_round"] / 1e9) <= 1e-6
+
+
+@pytest.mark.slow
+def test_bench_serve_columns():
+    """Round-12 serving columns: GOSSIP_BENCH_SERVE=N adds p50/p99
+    admission-to-result latency and qps from a resident in-process
+    server — and the qps column is reproducible from the row alone
+    (serve_n / serve_wall_s, the roofline_frac provenance
+    discipline).  Slow-marked (a whole extra bench subprocess); the
+    tier-1 run pins the columns' ABSENCE when the knob is off in
+    test_bench_cpu_run_is_labeled_and_complete."""
+    proc, rec = _run({"GOSSIP_BENCH_PLATFORM": "cpu",
+                      "JAX_PLATFORMS": "cpu",
+                      "GOSSIP_BENCH_SERVE": "4",
+                      "GOSSIP_BENCH_SERVE_PEERS": "4096",
+                      "GOSSIP_BENCH_SERVE_SLOTS": "4"})
+    assert proc.returncode == 0, proc.stderr
+    assert rec["serve_n"] == 4 and rec["serve_peers"] == 4096
+    assert rec["serve_p99_ms"] >= rec["serve_p50_ms"] > 0
+    assert rec["serve_wall_s"] > 0
+    expect = rec["serve_n"] / rec["serve_wall_s"]
+    assert abs(rec["serve_qps"] - expect) <= 1e-3 + 0.01 * expect
 
 
 def test_bench_stagger_and_block_perm_knobs():

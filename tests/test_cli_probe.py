@@ -147,6 +147,86 @@ def test_plugin_marker_detection(monkeypatch):
     assert engines._plugin_marker_present() is True
 
 
+class _FlakyRun:
+    """subprocess.run stub: fails the first ``n_failures`` probes, then
+    succeeds — the transient-outage shape (tunnel blip, spawn race at
+    container start) that used to pin CPU forever via the memo."""
+
+    def __init__(self, n_failures):
+        self.n_failures = n_failures
+        self.calls = 0
+
+    def __call__(self, *a, **k):
+        self.calls += 1
+        import types
+
+        rc = 1 if self.calls <= self.n_failures else 0
+        return types.SimpleNamespace(returncode=rc)
+
+
+def _armed_probe(monkeypatch, runner):
+    """Arm probe_backend to actually run: plugin marker present, no CPU
+    pin, no memo, an apparently-uninitialized backend, and the
+    subprocess seam replaced by ``runner``.  Returns a recorder of any
+    jax_platforms pin so a confirmed miss is observable without
+    mutating real global config."""
+    import jax
+
+    from p2p_gossipprotocol_tpu import engines
+
+    monkeypatch.delenv("GOSSIP_NO_BACKEND_PROBE", raising=False)
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    monkeypatch.setenv("GOSSIP_PROBE_TIMEOUT_S", "5")
+    monkeypatch.setattr(engines.subprocess, "run", runner)
+    # the suite's jax is long-initialized; the probe must not take the
+    # already-initialized early exit for this unit test
+    monkeypatch.setattr(jax._src.xla_bridge, "_backends", {})
+    pins = []
+    monkeypatch.setattr(jax.config, "update",
+                        lambda k, v: pins.append((k, v)))
+    monkeypatch.setattr(engines, "_PROBE_STATE", [])
+    return pins
+
+
+def test_probe_transient_failure_retries_then_passes(monkeypatch):
+    """ONE failed probe is retried, not memoized: a flaky stub that
+    fails once then succeeds must yield a healthy verdict (no CPU pin),
+    and the memo must record success (no further subprocess probes)."""
+    from p2p_gossipprotocol_tpu import engines
+
+    runner = _FlakyRun(n_failures=1)
+    pins = _armed_probe(monkeypatch, runner)
+    assert engines.probe_backend() is False      # healthy, no fallback
+    assert runner.calls == 2                     # probe + one retry
+    assert pins == []                            # never pinned CPU
+    assert engines.probe_backend() is False      # memoized
+    assert runner.calls == 2
+
+
+def test_probe_confirmed_miss_pins_after_retry(monkeypatch):
+    """Two consecutive failures ARE a confirmed miss: the fallback pins
+    CPU exactly once, after exactly two probe attempts."""
+    from p2p_gossipprotocol_tpu import engines
+
+    runner = _FlakyRun(n_failures=99)
+    pins = _armed_probe(monkeypatch, runner)
+    assert engines.probe_backend() is True       # fell back
+    assert runner.calls == 2                     # retried before pinning
+    assert pins == [("jax_platforms", "cpu")]
+    assert engines.probe_backend() is True       # memoized verdict
+    assert runner.calls == 2
+
+
+def test_probe_healthy_first_try_probes_once(monkeypatch):
+    from p2p_gossipprotocol_tpu import engines
+
+    runner = _FlakyRun(n_failures=0)
+    pins = _armed_probe(monkeypatch, runner)
+    assert engines.probe_backend() is False
+    assert runner.calls == 1                     # no needless retry
+    assert pins == []
+
+
 def test_probe_opt_out():
     """GOSSIP_NO_BACKEND_PROBE=1 skips the probe entirely (no fallback
     message even with an impossible timeout)."""

@@ -1,0 +1,463 @@
+"""Serving plane (serve/): continuous batching over the fleet engine.
+
+Module name contains "serve", so conftest's per-test SIGALRM guard
+covers the socket/subprocess tests automatically.
+
+The load-bearing contract, extended from the fleet's: every scenario
+served through the RESIDENT server — including one admitted mid-flight
+into a slot another scenario retired from, while unrelated scenarios
+ran on around it — is **bitwise-identical to its solo AlignedSimulator
+run**: state, mutated topology, every per-round metric.  On top of
+that: admission into a hot bucket must never recompile
+(``FleetBucket.trace_count``), the bounded queue must reject with an
+explicit reason, and SIGTERM salvage + resume must complete every
+previously admitted scenario bitwise.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from p2p_gossipprotocol_tpu.config import NetworkConfig
+from p2p_gossipprotocol_tpu.fleet import build_scenarios
+from p2p_gossipprotocol_tpu.fleet.engine import METRIC_KEYS
+from p2p_gossipprotocol_tpu.fleet.packer import bucket_signature
+from p2p_gossipprotocol_tpu.serve import GossipService, ServeReject
+from p2p_gossipprotocol_tpu.serve.scheduler import Request
+from p2p_gossipprotocol_tpu.serve.service import ServeBucket
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BASE_CFG = """\
+127.0.0.1:8000
+backend=jax
+n_peers=1024
+n_messages=16
+avg_degree=8
+rounds=32
+"""
+
+
+@pytest.fixture(scope="module")
+def base_cfg(tmp_path_factory):
+    p = tmp_path_factory.mktemp("serve") / "network.txt"
+    p.write_text(BASE_CFG)
+    return NetworkConfig(str(p))
+
+
+def _spec(base_cfg, overrides):
+    return build_scenarios(base_cfg, [overrides])[0]
+
+
+def _request(base_cfg, overrides, rid=0):
+    spec = _spec(base_cfg, overrides)
+    spec.index = rid
+    return Request(rid=rid, overrides=dict(overrides), spec=spec,
+                   signature=bucket_signature(spec.sim),
+                   t_enqueue=time.perf_counter())
+
+
+def _assert_bitwise(serve_res, solo_res, what):
+    """The fleet suite's full-leaf compare: metrics + state + rewired
+    lanes, all bit-for-bit."""
+    for k in METRIC_KEYS:
+        f, s = getattr(serve_res, k), getattr(solo_res, k)
+        assert np.array_equal(f, s), (what, k, f, s)
+    for k in ("seen_w", "frontier_w", "alive_b", "byz_w", "round",
+              "key"):
+        f = np.asarray(jax.device_get(getattr(serve_res.state, k)))
+        s = np.asarray(jax.device_get(getattr(solo_res.state, k)))
+        assert np.array_equal(f, s), (what, "state." + k)
+    fs, ss = serve_res.state.strikes, solo_res.state.strikes
+    assert (fs is None) == (ss is None), (what, "strikes presence")
+    if fs is not None:
+        assert np.array_equal(np.asarray(jax.device_get(fs)),
+                              np.asarray(jax.device_get(ss))), (
+                                  what, "state.strikes")
+    assert np.array_equal(
+        np.asarray(jax.device_get(serve_res.topo.colidx)),
+        np.asarray(jax.device_get(solo_res.topo.colidx))), (
+            what, "topo.colidx")
+
+
+def _drive(bucket, max_rounds=64):
+    """Run chunks until the bucket idles; returns {rid: (occ, res)}."""
+    out = {}
+    while bucket.live():
+        ys, dh = bucket.dispatch()
+        for _slot, occ, res in bucket.collect(ys, dh, max_rounds):
+            out[occ.req.rid] = (occ, res)
+    return out
+
+
+# ---------------------------------------------------------------------
+# deterministic slot-swap machinery (no threads)
+
+@pytest.mark.slow
+def test_midflight_admission_bitwise_parity(base_cfg):
+    """Scenarios admitted at three different chunk boundaries into one
+    resident bucket — different seeds, padded peer counts, churn —
+    each produce results bitwise-identical to their solo runs.
+    Admission into a RUNNING bucket must not perturb anything already
+    resident, and the residents must not perturb the newcomer.
+    Slow-marked (three solo reference runs): tier-1 keeps the
+    slot-reuse prefix-parity test, whose retire/admit cycle covers the
+    same scatter seam at a third of the cost — the seed-era suite
+    already runs the 870 s tier-1 budget to the line on one core."""
+    lines = [{"prng_seed": 0, "churn_rate": 0.05},
+             {"prng_seed": 3, "churn_rate": 0.05},
+             # off-grid peer count: pads back onto the bucket's row
+             # grid (recorded n_peers_requested), same signature
+             {"prng_seed": 5, "churn_rate": 0.05, "n_peers": 1000}]
+    tmpl = _spec(base_cfg, lines[0])
+    bucket = ServeBucket(tmpl, slots=3, chunk=2, target=0.99)
+    reqs = [_request(base_cfg, ov, rid=i) for i, ov in enumerate(lines)]
+    served = {}
+
+    bucket.admit(reqs[0])
+    for i in (1, 2):                       # staggered mid-flight admits
+        ys, dh = bucket.dispatch()
+        for _s, occ, res in bucket.collect(ys, dh, 64):
+            served[occ.req.rid] = (occ, res)
+        bucket.admit(reqs[i])
+    served.update(_drive(bucket))
+
+    assert set(served) == {0, 1, 2}
+    for i, ov in enumerate(lines):
+        occ, res = served[i]
+        r_i = bucket.rounds_run_of(occ)
+        assert occ.converged > 0 and len(res.coverage) == r_i
+        solo = _spec(base_cfg, ov).sim.run(r_i)
+        _assert_bitwise(res, solo, f"mid-flight scenario {i}")
+
+
+@pytest.mark.slow
+def test_midflight_admission_with_faults_and_modes(base_cfg):
+    """The cross-product seam: seeds x modes x fault plans.  Fault
+    plans and modes change the program signature (their own buckets);
+    seeds mix within one.  Every served scenario stays solo-bitwise.
+    Broadest matrix -> slow-marked per the frontier precedent (the
+    tier-1 run keeps test_midflight_admission_bitwise_parity and the
+    service-level mixed test)."""
+    families = [
+        [{"prng_seed": 0}, {"prng_seed": 4}],
+        [{"prng_seed": 1, "mode": "pushpull", "fault_link_drop": 0.2,
+          "fault_partition": "1:4", "fault_seed": 7},
+         {"prng_seed": 6, "mode": "pushpull", "fault_link_drop": 0.2,
+          "fault_partition": "1:4", "fault_seed": 7}],
+    ]
+    rid = 0
+    for fam in families:
+        tmpl = _spec(base_cfg, fam[0])
+        bucket = ServeBucket(tmpl, slots=2, chunk=2, target=0.99)
+        reqs = []
+        for ov in fam:
+            reqs.append(_request(base_cfg, ov, rid=rid))
+            rid += 1
+        bucket.admit(reqs[0])
+        ys, dh = bucket.dispatch()           # second admit mid-flight
+        served = {occ.req.rid: (occ, res)
+                  for _s, occ, res in bucket.collect(ys, dh, 64)}
+        bucket.admit(reqs[1])
+        served.update(_drive(bucket))
+        for req, ov in zip(reqs, fam):
+            occ, res = served[req.rid]
+            r_i = bucket.rounds_run_of(occ)
+            solo = _spec(base_cfg, ov).sim.run(r_i)
+            _assert_bitwise(res, solo, f"fam scenario {ov}")
+
+
+def test_slot_reuse_prefix_parity(base_cfg):
+    """A retire/admit cycle on ONE slot: the second tenant's trajectory
+    — both its mid-flight prefix and its final result — is bitwise the
+    solo run's, proving the retiree's frozen world never leaks into the
+    reused slot."""
+    tmpl = _spec(base_cfg, {"prng_seed": 0})
+    bucket = ServeBucket(tmpl, slots=1, chunk=2, target=0.99)
+    first = _request(base_cfg, {"prng_seed": 0}, rid=0)
+    bucket.admit(first, slot=0)
+    served = _drive(bucket)
+    assert 0 in served and served[0][0].converged > 0
+
+    second = _request(base_cfg, {"prng_seed": 11}, rid=1)
+    bucket.admit(second, slot=0)             # the SAME slot, reused
+    ys, dh = bucket.dispatch()
+    retired = bucket.collect(ys, dh, 64)
+    occ = bucket.occupants[0] if bucket.occupants[0] is not None \
+        else retired[0][1]
+    # prefix parity after the first chunk of the second tenancy
+    prefix = np.concatenate(occ.hist["coverage"])
+    solo2 = _spec(base_cfg, {"prng_seed": 11}).sim.run(len(prefix))
+    assert np.array_equal(prefix, solo2.coverage), "reused-slot prefix"
+    served.update({o.req.rid: (o, r) for _s, o, r in retired})
+    served.update(_drive(bucket))
+    occ2, res2 = served[1]
+    r_i = bucket.rounds_run_of(occ2)
+    _assert_bitwise(res2, _spec(base_cfg, {"prng_seed": 11}).sim.run(r_i),
+                    "reused-slot final")
+
+
+def test_admission_never_recompiles(base_cfg):
+    """The continuous-batching economics: admitting new scenarios into
+    a hot bucket is a pure array scatter against the ONE cached chunk
+    program — trace_count stays 1 across a whole rotating population."""
+    tmpl = _spec(base_cfg, {"prng_seed": 0})
+    bucket = ServeBucket(tmpl, slots=2, chunk=4, target=0.99)
+    rid = 0
+    for wave in range(3):
+        for _ in range(2):
+            bucket.admit(_request(base_cfg, {"prng_seed": rid}, rid=rid))
+            rid += 1
+        _drive(bucket)
+    assert bucket.fleet.trace_count == 1, (
+        "slot-swap admission retraced the chunk program")
+
+
+def test_admit_signature_mismatch_is_named(base_cfg):
+    tmpl = _spec(base_cfg, {"prng_seed": 0})
+    bucket = ServeBucket(tmpl, slots=2, chunk=2, target=0.99)
+    wrong = _request(base_cfg, {"prng_seed": 1, "mode": "pull"}, rid=9)
+    with pytest.raises(ValueError, match="signature"):
+        bucket.admit(wrong)
+
+
+# ---------------------------------------------------------------------
+# the GossipService facade
+
+@pytest.mark.slow
+def test_service_mixed_parity_and_latency(base_cfg):
+    """Facade end-to-end: heterogeneous submissions route to signature
+    buckets, every result is solo-bitwise, rows carry the
+    enqueue→admit→converge→result latency split, and /stats reports
+    p50/p99 with zero chunk retraces beyond one per bucket.
+    Slow-marked (service thread + solo reference runs); tier-1 keeps
+    the socket end-to-end test on the same facade."""
+    svc = GossipService(base_cfg, slots=4, target=0.99,
+                        rounds=32).start()
+    lines = [{"prng_seed": 0}, {"prng_seed": 2},
+             {"prng_seed": 3, "mode": "pull"}]
+    rids = [svc.submit(ov) for ov in lines]
+    rows = [svc.result(r, timeout=300) for r in rids]
+    for row, ov in zip(rows, lines):
+        assert row["converged"], row
+        assert row["latency_ms"] > 0 and row["serve_ms"] >= 0
+        assert row["queue_ms"] >= 0
+        res = svc.sim_result(row["request"])
+        solo = _spec(base_cfg, ov).sim.run(row["rounds_run"])
+        _assert_bitwise(res, solo, f"service scenario {ov}")
+    st = svc.drain()
+    assert st["done"] == 3 and st["failed"] == 0
+    assert st["p99_ms"] >= st["p50_ms"] > 0
+    assert st["buckets"] == 2                  # push / pull
+    assert st["chunk_retraces"] == st["buckets"]
+
+
+def test_service_backpressure_rejects_with_reason(base_cfg):
+    """Bounded queue: the (queue_max+1)-th submission is rejected with
+    an explicit reason, not silently buffered; a resolution error is a
+    named rejection at the door; and a draining server refuses new
+    work.  (The worker thread is never started, so the queue cannot
+    drain under the test.)"""
+    svc = GossipService(base_cfg, slots=2, queue_max=2, target=0.99)
+    with pytest.raises(ServeReject, match="bad scenario"):
+        svc.submit({"not_a_key": 1})
+    svc.submit({"prng_seed": 0})
+    svc.submit({"prng_seed": 1})
+    with pytest.raises(ServeReject, match="queue full"):
+        svc.submit({"prng_seed": 2})
+    assert svc.stats()["rejected"] == 2
+    svc.scheduler.stop_accepting()
+    with pytest.raises(ServeReject, match="draining"):
+        svc.submit({"prng_seed": 3})
+    assert svc.stats()["rejected"] == 3
+
+
+@pytest.mark.slow
+def test_service_salvage_resume_bitwise(base_cfg, tmp_path):
+    """The preemption contract on a server: salvage mid-serve persists
+    in-flight buckets AND the queue; a resumed service completes every
+    previously admitted scenario with solo-bitwise results and replays
+    completed rows under their original request ids.  Slow-marked with
+    the CLI SIGTERM e2e (the budget rationale above); tier-1 keeps the
+    fingerprint-drift refusal, which exercises salvage + manifest."""
+    ck = str(tmp_path / "ck")
+    lines = [{"prng_seed": s, "mode": "pull"} for s in range(3)]
+    lines.append({"prng_seed": 7})            # second signature, queued
+    svc = GossipService(base_cfg, slots=4, target=0.999, rounds=64,
+                        chunk=2, max_buckets=1,
+                        checkpoint_dir=ck).start()
+    rids = [svc.submit(ov) for ov in lines]
+    deadline = time.time() + 60
+    while time.time() < deadline:             # let some chunks land
+        if svc.stats()["running"] >= 3:
+            time.sleep(0.5)
+            break
+        time.sleep(0.05)
+    svc.salvage(timeout=120)
+    assert svc.salvaged
+    assert os.path.exists(os.path.join(ck, "serve_manifest.json"))
+
+    svc2 = GossipService(base_cfg, slots=4, target=0.999, rounds=64,
+                         chunk=2, max_buckets=1, checkpoint_dir=ck,
+                         resume=True).start()
+    rows = [svc2.result(r, timeout=300) for r in rids]
+    svc2.drain()
+    for row, ov in zip(rows, lines):
+        assert row["converged"], row
+        res = svc2.sim_result(row["request"])
+        if res is None:       # completed pre-salvage: row-replay only
+            continue
+        solo = _spec(base_cfg, ov).sim.run(row["rounds_run"])
+        _assert_bitwise(res, solo, f"resumed scenario {ov}")
+
+
+def test_service_resume_refuses_base_drift(base_cfg, tmp_path):
+    from p2p_gossipprotocol_tpu.utils.checkpoint import \
+        FingerprintMismatch
+
+    ck = str(tmp_path / "ck")
+    svc = GossipService(base_cfg, slots=2, target=0.999, rounds=64,
+                        chunk=2, checkpoint_dir=ck).start()
+    svc.submit({"prng_seed": 0, "mode": "pull"})
+    deadline = time.time() + 60
+    while svc.stats()["running"] < 1 and time.time() < deadline:
+        time.sleep(0.05)
+    svc.salvage(timeout=120)
+
+    p = tmp_path / "drifted.txt"
+    p.write_text(BASE_CFG.replace("avg_degree=8", "avg_degree=6"))
+    drifted = NetworkConfig(str(p))
+    with pytest.raises(FingerprintMismatch):
+        GossipService(drifted, slots=2, target=0.999, rounds=64,
+                      chunk=2, checkpoint_dir=ck, resume=True)
+
+
+# ---------------------------------------------------------------------
+# the socket surface
+
+def test_socket_server_end_to_end(base_cfg):
+    """The wire: submit/result/stats/reject/drain over real TCP through
+    the transport layer's framing, against an in-process server."""
+    from p2p_gossipprotocol_tpu.serve.server import (ServeClient,
+                                                     ServeServer)
+
+    svc = GossipService(base_cfg, slots=2, target=0.99, rounds=32)
+    server = ServeServer(svc, "127.0.0.1", 0, wire_format="framed")
+    server.start()                      # port 0 -> ephemeral bind
+    try:
+        c = ServeClient("127.0.0.1", server.port, wire_format="framed")
+        rid = c.submit({"prng_seed": 0})
+        row = c.result(rid, timeout=300)
+        assert row["converged"] and row["request"] == rid
+        with pytest.raises(ServeReject, match="bad scenario"):
+            c.submit({"bogus": 1})
+        st = c.stats()
+        assert st["type"] == "stats" and st["done"] == 1
+        drained = c.drain()
+        assert drained["type"] == "drained" and drained["done"] == 1
+        c.close()
+    finally:
+        server.stop()
+
+
+@pytest.mark.slow
+def test_cli_serve_sigterm_salvage_resume(base_cfg, tmp_path):
+    """CLI e2e (the broadest path, slow-marked per the frontier
+    precedent): --serve accepts wire submissions, SIGTERM salvages
+    in-flight buckets + queue and exits 75, and --serve --resume
+    completes every previously admitted scenario; results append to the
+    torn-line-safe JSONL table."""
+    from p2p_gossipprotocol_tpu.serve.server import ServeClient
+
+    ck = str(tmp_path / "ck")
+    rows_path = str(tmp_path / "rows.jsonl")
+    port = 19620 + (os.getpid() % 200)
+    cfg_p = tmp_path / "serve.txt"
+    cfg_p.write_text(BASE_CFG.replace("rounds=32", "rounds=64")
+                     + f"local_ip=127.0.0.1\nlocal_port={port}\n"
+                       "serve_chunk=2\nserve_target=0.999\n"
+                       f"serve_results={rows_path}\n")
+    env = {"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+           "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+           "HOME": os.environ.get("HOME", "/root")}
+    args = [sys.executable, "-m", "p2p_gossipprotocol_tpu.cli",
+            str(cfg_p), "--serve", "--checkpoint-dir", ck]
+
+    proc = subprocess.Popen(args, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, env=env)
+    try:
+        client = None
+        deadline = time.time() + 120
+        while client is None and time.time() < deadline:
+            try:
+                client = ServeClient("127.0.0.1", port, timeout=2)
+            except OSError:
+                assert proc.poll() is None, proc.communicate()[1][-2000:]
+                time.sleep(0.25)
+        assert client is not None, "server never came up"
+        rids = [client.submit({"prng_seed": s, "mode": "pull"})
+                for s in range(3)]
+        time.sleep(2.0)                      # let some chunks land
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=120)
+        assert proc.returncode == 75, (out, err[-2000:])
+        assert os.path.exists(os.path.join(ck, "serve_manifest.json"))
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    proc2 = subprocess.Popen(args + ["--resume"], stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, text=True, env=env)
+    try:
+        client = None
+        deadline = time.time() + 120
+        while client is None and time.time() < deadline:
+            try:
+                client = ServeClient("127.0.0.1", port, timeout=2)
+            except OSError:
+                assert proc2.poll() is None, \
+                    proc2.communicate()[1][-2000:]
+                time.sleep(0.25)
+        assert client is not None, "resumed server never came up"
+        rows = [client.result(r, timeout=300) for r in rids]
+        assert all(r["converged"] for r in rows)
+        client.drain()
+        out, err = proc2.communicate(timeout=120)
+        assert proc2.returncode == 0, (out, err[-2000:])
+    finally:
+        if proc2.poll() is None:
+            proc2.kill()
+    from p2p_gossipprotocol_tpu.fleet import read_rows
+
+    table = read_rows(rows_path)
+    assert {r["request"] for r in table} >= set(rids)
+
+
+def test_wrapper_refuses_serve(tmp_path):
+    from p2p_gossipprotocol_tpu.wrapper import Peer
+
+    p = tmp_path / "serve.txt"
+    p.write_text(BASE_CFG + "serve=1\n")
+    cfg = NetworkConfig(str(p))
+    with pytest.raises(ValueError, match="GossipService"):
+        Peer(str(p), config=cfg)
+
+
+def test_serve_config_validation(tmp_path):
+    from p2p_gossipprotocol_tpu.config import ConfigError
+
+    p = tmp_path / "bad.txt"
+    p.write_text(BASE_CFG + "serve_target=1.5\n")
+    with pytest.raises(ConfigError, match="serve_target"):
+        NetworkConfig(str(p))
+    p.write_text(BASE_CFG + "serve_slots=0\n")
+    with pytest.raises(ConfigError, match="serve_slots"):
+        NetworkConfig(str(p))
