@@ -141,7 +141,13 @@ class Scheduler:
                     f"queue full ({self.queue_max} waiting; retry "
                     "later or raise serve_queue_max)")
             if rid is None:
+                # reserve the id before dropping the lock: two
+                # concurrent submits (one handler thread per
+                # connection) must never share a rid — a duplicate
+                # would overwrite the first registration and enqueue
+                # the survivor twice
                 rid = self._next_rid
+                self._next_rid += 1
         try:
             spec = resolve_request(self.base_cfg,
                                    copy.deepcopy(overrides), rid,
@@ -161,6 +167,8 @@ class Scheduler:
                 raise ServeReject(
                     f"queue full ({self.queue_max} waiting; retry "
                     "later or raise serve_queue_max)")
+            # fresh rids are reserved above; this only advances past
+            # explicit resume rids
             self._next_rid = max(self._next_rid, rid + 1)
             self.requests[rid] = req
             self.queue.append(rid)

@@ -90,6 +90,9 @@ class ServeServer:
             t = threading.Thread(target=self._handle, args=(conn,),
                                  daemon=True)
             t.start()
+            # prune finished handlers so a resident server doesn't
+            # accumulate one dead Thread per past connection
+            self._threads = [h for h in self._threads if h.is_alive()]
             self._threads.append(t)
 
     def _handle(self, conn: socket.socket) -> None:

@@ -55,8 +55,9 @@ import jax.numpy as jnp
 from p2p_gossipprotocol_tpu.fleet.engine import (METRIC_DTYPES,
                                                  METRIC_KEYS, FleetBucket,
                                                  _unstack_topology)
-from p2p_gossipprotocol_tpu.serve.scheduler import (DONE, QUEUED, RUNNING,
-                                                    Request, Scheduler,
+from p2p_gossipprotocol_tpu.serve.scheduler import (DONE, FAILED, QUEUED,
+                                                    RUNNING, Request,
+                                                    Scheduler, ServeReject,
                                                     resolve_request)
 
 #: serve manifest schema (the sweep manifest's sibling; fingerprint /
@@ -146,15 +147,29 @@ class ServeBucket:
             req._staged_payload = self.fleet.admit_args(req.spec.sim)
 
     # ------------------------------------------------------------------
-    def dispatch(self):
-        """Run one chunk (async — the returned metric arrays are
-        futures until device_get)."""
-        fn = self.fleet._chunk_fn(self.chunk, self.target)
+    def next_step(self, max_rounds: int) -> int:
+        """The next chunk length: ``chunk``, clamped so no occupant
+        runs past its ``max_rounds`` cap (when the cap is not a chunk
+        multiple the final chunk is shorter — the batch-offline
+        ``FleetBucket.run`` final-chunk idiom; ``_chunk_fn`` caches per
+        length, so each distinct short length compiles once per
+        bucket)."""
+        rem = [max_rounds - o.rounds for o in self.occupants
+               if o is not None]
+        return max(1, min([self.chunk] + rem))
+
+    def dispatch(self, step: int | None = None):
+        """Run one chunk of ``step`` rounds (default the bucket chunk;
+        async — the returned metric arrays are futures until
+        device_get)."""
+        step = self.chunk if step is None else step
+        fn = self.fleet._chunk_fn(step, self.target)
         (self.state, self.topo, self.done, ys, dhist) = fn(
             self.state, self.topo, self.done, self.seeds, self.srcs)
         return ys, dhist
 
-    def collect(self, ys, dhist, max_rounds: int):
+    def collect(self, ys, dhist, max_rounds: int,
+                step: int | None = None):
         """Read back one chunk's metrics and retire finished occupants.
         Returns ``[(slot, occupant, sim_result), ...]`` for every
         scenario that converged (its history truncated at its exact
@@ -162,7 +177,7 @@ class ServeBucket:
         slot force-frozen)."""
         from p2p_gossipprotocol_tpu.sim import SimResult
 
-        step = self.chunk
+        step = self.chunk if step is None else step
         ys = {k: np.asarray(jax.device_get(ys[k])) for k in METRIC_KEYS}
         dh = np.asarray(jax.device_get(dhist))
         retired = []
@@ -238,8 +253,14 @@ class GossipService:
         self._draining = threading.Event()
         self._salvage = threading.Event()
         self._wake = threading.Event()
+        # occupancy snapshot for /stats: published (atomic dict swap)
+        # by whichever thread owns the buckets at the time — __init__/
+        # _resume before the loop starts, the serving loop after — so
+        # handler threads never iterate buckets the loop is mutating
+        self._occupancy: dict = {}
         if resume:
             self._resume()
+        self._publish_occupancy()
 
     # -- fingerprint ---------------------------------------------------
     def _fingerprint(self) -> str:
@@ -269,8 +290,16 @@ class GossipService:
     def submit(self, overrides: dict) -> int:
         """Enqueue one scenario (a JSONL-line config dict); returns its
         request id.  Raises :class:`ServeReject` — full queue, draining
-        server, unresolvable scenario — the explicit-backpressure
-        contract."""
+        server, dead serving loop, unresolvable scenario — the
+        explicit-backpressure contract: a request the loop can never
+        serve is refused at the door, not accepted to hang."""
+        if self._error is not None:
+            raise ServeReject("serving loop failed: "
+                              f"{type(self._error).__name__}: "
+                              f"{self._error}")
+        if self._thread is not None and not self._thread.is_alive():
+            raise ServeReject("serving loop has stopped "
+                              "(drained or salvaged)")
         req = self.scheduler.submit(overrides)
         self._wake.set()
         return req.rid
@@ -278,13 +307,18 @@ class GossipService:
     def result(self, rid: int, timeout: float | None = None) -> dict:
         """Block until request ``rid`` completes; returns its results
         row.  Raises KeyError for an unknown id, TimeoutError on
-        timeout, and re-raises a serving-loop failure."""
+        timeout, and re-raises a serving-loop failure — a FAILED
+        request never masquerades as a results row."""
         req = self.scheduler.requests[rid]
         if not req.done_event.wait(timeout):
             raise TimeoutError(f"request {rid} not done within "
                                f"{timeout}s")
-        if self._error is not None and req.row is None:
-            raise self._error
+        if req.status == FAILED:
+            if self._error is not None:
+                raise self._error
+            raise RuntimeError(
+                (req.row or {}).get("error",
+                                    f"request {rid} failed"))
         return req.row
 
     def sim_result(self, rid: int):
@@ -293,16 +327,27 @@ class GossipService:
         solo runs."""
         return self.scheduler.requests[rid].result
 
+    def _publish_occupancy(self) -> None:
+        """Build a fresh occupancy snapshot and swap it in (atomic
+        reference assignment — readers see the old dict or the new one,
+        never a half-mutated bucket list).  Called only by the thread
+        that currently owns the buckets."""
+        self._occupancy = {
+            "buckets": len(self.buckets),
+            "slots": sum(b.slots for b in self.buckets),
+            "slots_free": sum(len(b.free_slots())
+                              for b in self.buckets),
+            "chunk_retraces": sum(b.fleet.trace_count
+                                  for b in self.buckets),
+        }
+
     def stats(self) -> dict:
         """The ``/stats`` payload: scheduler ledger + resident-bucket
-        occupancy + the zero-recompile counter."""
+        occupancy + the zero-recompile counter.  Occupancy comes from
+        the loop-published snapshot (at most one chunk stale), not a
+        live iteration over buckets the loop may be mutating."""
         out = self.scheduler.stats()
-        out["buckets"] = len(self.buckets)
-        out["slots"] = sum(b.slots for b in self.buckets)
-        out["slots_free"] = sum(len(b.free_slots())
-                                for b in self.buckets)
-        out["chunk_retraces"] = sum(b.fleet.trace_count
-                                    for b in self.buckets)
+        out.update(self._occupancy)
         return out
 
     def drain(self, timeout: float | None = None) -> dict:
@@ -410,6 +455,7 @@ class GossipService:
                     self.salvaged = True
                     return
                 self._admit_pending()
+                self._publish_occupancy()
                 active = [b for b in self.buckets if b.live()]
                 if not active:
                     if self._draining.is_set() \
@@ -419,21 +465,35 @@ class GossipService:
                     self._wake.clear()
                     continue
                 for b in active:
-                    ys, dhist = b.dispatch()
+                    # clamp the final chunk so rounds_run never exceeds
+                    # the serve_rounds cap (chunk boundaries need not
+                    # divide it)
+                    step = b.next_step(self.rounds)
+                    ys, dhist = b.dispatch(step)
                     # overlap seam: stage the next admissions while the
                     # chunk executes; collect() below is the sync point
                     self._stage_pending()
                     for slot, occ, res in b.collect(ys, dhist,
-                                                    self.rounds):
+                                                    self.rounds,
+                                                    step=step):
                         self._finish(self.buckets.index(b), occ, res)
+                self._publish_occupancy()
         except Exception as e:  # noqa: BLE001 — surface via result()
             self._error = e
+            # refuse new submissions BEFORE failing the pending ones:
+            # scheduler registration and stop_accepting share a lock,
+            # so every request registered is in the snapshot below and
+            # every later submit is rejected — none can slip between
+            # and hang
+            self.scheduler.stop_accepting()
             for req in list(self.scheduler.requests.values()):
                 if req.status in (RUNNING, QUEUED):
                     self.scheduler.finish(
                         req, {"request": req.rid,
                               "error": f"{type(e).__name__}: {e}"},
                         failed=True)
+        finally:
+            self._publish_occupancy()
 
     # -- salvage / resume ----------------------------------------------
     def _manifest_path(self) -> str:

@@ -221,6 +221,28 @@ def test_admission_never_recompiles(base_cfg):
         "slot-swap admission retraced the chunk program")
 
 
+def test_round_cap_clamps_to_serve_rounds(base_cfg):
+    """A serve_rounds cap that is NOT a chunk multiple: the final chunk
+    is clamped, so the scenario retires at exactly the cap — never
+    chunk-1 rounds past it — and its truncated trajectory is still
+    bitwise the solo run's."""
+    tmpl = _spec(base_cfg, {"prng_seed": 0})
+    bucket = ServeBucket(tmpl, slots=1, chunk=8, target=None)
+    bucket.admit(_request(base_cfg, {"prng_seed": 0}, rid=0), slot=0)
+    served = {}
+    while bucket.live():
+        step = bucket.next_step(5)
+        assert step <= 5
+        ys, dh = bucket.dispatch(step)
+        for _s, occ, res in bucket.collect(ys, dh, 5, step=step):
+            served[occ.req.rid] = (occ, res)
+    occ, res = served[0]
+    assert occ.converged < 0 and occ.rounds == 5
+    assert bucket.rounds_run_of(occ) == 5 and len(res.coverage) == 5
+    _assert_bitwise(res, _spec(base_cfg, {"prng_seed": 0}).sim.run(5),
+                    "cap-clamped scenario")
+
+
 def test_admit_signature_mismatch_is_named(base_cfg):
     tmpl = _spec(base_cfg, {"prng_seed": 0})
     bucket = ServeBucket(tmpl, slots=2, chunk=2, target=0.99)
@@ -278,6 +300,53 @@ def test_service_backpressure_rejects_with_reason(base_cfg):
     with pytest.raises(ServeReject, match="draining"):
         svc.submit({"prng_seed": 3})
     assert svc.stats()["rejected"] == 3
+
+
+def test_concurrent_submits_get_unique_rids(base_cfg):
+    """The submit path is one-handler-thread-per-connection: concurrent
+    submissions must each reserve their own request id — a shared rid
+    would overwrite one client's registration and serve the survivor
+    twice."""
+    import threading as _threading
+
+    svc = GossipService(base_cfg, slots=2, queue_max=64, target=0.99)
+    rids, errs = [], []
+
+    def _one(seed):
+        try:
+            rids.append(svc.submit({"prng_seed": seed}))
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    threads = [_threading.Thread(target=_one, args=(s,))
+               for s in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert len(rids) == 6 and len(set(rids)) == 6, rids
+    assert sorted(svc.scheduler.requests) == sorted(rids)
+    assert list(svc.scheduler.queue) != []
+
+
+def test_loop_failure_raises_and_rejects_new_work(base_cfg):
+    """A dead serving loop must not fake success: result() re-raises
+    the loop's failure instead of returning the error row as if it
+    were a results row, and later submits are rejected at the door
+    rather than accepted to hang."""
+    svc = GossipService(base_cfg, slots=2, target=0.99)
+
+    def _boom(req):
+        raise RuntimeError("injected bucket failure")
+
+    svc._bucket_for = _boom
+    svc.start()
+    rid = svc.submit({"prng_seed": 0})
+    with pytest.raises(RuntimeError, match="injected bucket failure"):
+        svc.result(rid, timeout=60)
+    with pytest.raises(ServeReject, match="serving loop failed"):
+        svc.submit({"prng_seed": 1})
 
 
 @pytest.mark.slow
