@@ -71,6 +71,12 @@ report serve_p50_ms / serve_p99_ms (admission-to-result latency) and
 serve_qps — reproducible from the row alone as serve_n /
 serve_wall_s; the offered-load sweep with Poisson arrivals lives in
 benchmarks/measure_round12.py.
+GOSSIP_BENCH_TELEMETRY (0 = off): also A/B the chunked runner with
+the flight-recorder telemetry plane off vs on
+(GOSSIP_BENCH_TELEMETRY_ROUNDS, 16) and report obs_overhead_pct —
+the host-side observability tax in percent of ms/round (acceptance
+<= 3%; the full A/B with parity assertions lives in
+benchmarks/measure_round13.py).
 """
 
 from __future__ import annotations
@@ -506,6 +512,20 @@ def _bench_aligned(n, n_msgs, degree, mode):
         except Exception as e:  # noqa: BLE001 — column, not the line
             print(f"[bench] serve column failed ({type(e).__name__}: "
                   f"{e}); omitting serve fields", file=sys.stderr)
+    # Telemetry-overhead column (GOSSIP_BENCH_TELEMETRY=1): A/B the
+    # chunked runner with the flight-recorder plane off vs on — the
+    # honest price of spans + counters + the live roofline, in percent
+    # of ms/round.  The full A/B (262k + 1M, parity assertions) lives
+    # in benchmarks/measure_round13.py; a failure here degrades to a
+    # line without the column, never to no line.
+    obs = {}
+    if _env_int("GOSSIP_BENCH_TELEMETRY", 0) > 0:
+        try:
+            obs = _bench_obs_overhead(sim)
+        except Exception as e:  # noqa: BLE001 — column, not the line
+            print(f"[bench] telemetry column failed "
+                  f"({type(e).__name__}: {e}); omitting obs fields",
+                  file=sys.stderr)
     extras = {
         "liveness_every": liveness_every,
         "roll_groups": roll_groups,
@@ -529,8 +549,45 @@ def _bench_aligned(n, n_msgs, degree, mode):
         **steady,
         **fleet,
         **serve,
+        **obs,
     }
     return rounds, wall, total_seen, n_edges, graph_s, extras
+
+
+def _bench_obs_overhead(sim, rounds: int | None = None,
+                        every: int | None = None) -> dict:
+    """The ``obs_overhead_pct`` column: run the same fixed-round
+    chunked scan with telemetry off, then on, on an already-warm
+    program (run_chunked reuses the sim's per-length compile cache) and
+    report the relative ms/round cost of the host-side plane.  The
+    recorder's prior enabled state is restored whatever happens."""
+    from p2p_gossipprotocol_tpu import telemetry
+    from p2p_gossipprotocol_tpu.utils.checkpoint import run_chunked
+
+    rounds = rounds or _env_int("GOSSIP_BENCH_TELEMETRY_ROUNDS", 16)
+    every = every or max(1, rounds // 4)
+    rec = telemetry.recorder()
+    prev = rec.enabled
+
+    def timed(on: bool) -> float:
+        rec.configure(enabled=on)
+        t0 = time.perf_counter()
+        run_chunked(sim, rounds, every=every)
+        return time.perf_counter() - t0
+
+    try:
+        timed(False)                       # warm the chunk compiles
+        off = timed(False)
+        on = timed(True)
+    finally:
+        rec.configure(enabled=prev)
+    return {
+        "obs_rounds": rounds,
+        "obs_ms_per_round_off": round(off / rounds * 1e3, 3),
+        "obs_ms_per_round_on": round(on / rounds * 1e3, 3),
+        "obs_overhead_pct": round((on - off) / off * 100, 2)
+        if off > 0 else None,
+    }
 
 
 def _bench_serve(n_req: int, n_peers: int, slots: int) -> dict:

@@ -1,0 +1,269 @@
+"""Round-13 A/B: the flight-recorder telemetry plane's price and its
+serving surfaces, measured honestly.
+
+Three measurement families, one JSON row each (resumable per-config
+like the round-7..12 drivers):
+
+* ``telemetry_ab_{n}`` for each peer count in GOSSIP_R13_PEERS
+  (default "262144,1048576"): the SAME fixed-round chunked scan
+  (utils.checkpoint.run_chunked — the instrumented runner every
+  checkpointed/supervised run goes through) timed with telemetry OFF
+  and then ON, on a warm compile cache.  Reports ms/round both ways,
+  ``obs_overhead_pct``, and ``parity_ok`` — the two runs' final state
+  and full metric history compared bitwise (the observational
+  contract, the cross-product lives in tests/test_telemetry.py).
+  Acceptance (ISSUE 10): overhead <= 3% at 262k on the CPU path.
+
+* ``serve_scrape``: a LIVE resident server (GossipService under
+  ServeServer on an ephemeral port) serving real requests while a
+  ServeClient scrapes ``metrics`` — the row records which catalog
+  counters the page carried — and captures an on-demand bounded
+  ``profile`` that round-trips through telemetry.traceview.summarize
+  (== trace_top.py's accounting); ``profile_ops`` counts the summarized
+  ops.
+
+* ``flight_salvage``: an in-process serve salvage (the SIGTERM path's
+  body) must leave a READABLE flight-recorder dump alongside the
+  checkpoint manifest; the row records the dump's event kinds.  (The
+  full SIGTERM-75 process-level e2e lives in tests/test_telemetry.py.)
+
+Run (CPU or chip; watchdog chain step measure_round13):
+    PYTHONPATH=/root/repo python benchmarks/measure_round13.py
+Appends one JSON row per measurement to GOSSIP_R13_OUT (default
+benchmarks/results/round13_tpu.jsonl on TPU, round13_cpu.jsonl
+elsewhere).  Knobs: GOSSIP_R13_PEERS ("262144,1048576"),
+GOSSIP_R13_MSGS (16), GOSSIP_R13_ROUNDS (12), GOSSIP_R13_EVERY (4),
+GOSSIP_R13_SERVE_PEERS (16384), GOSSIP_R13_SERVE_N (6).
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+
+def _out_path(cpu: bool) -> str:
+    default = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "results",
+        "round13_cpu.jsonl" if cpu else "round13_tpu.jsonl")
+    return os.environ.get("GOSSIP_R13_OUT", default)
+
+
+OUT = None          # set in main() once the platform is known
+
+
+def emit(row):
+    row["device"] = str(jax.devices()[0]).replace(" ", "_")
+    row["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    print(json.dumps(row), flush=True)
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def _landed() -> set:
+    from benchmarks._common import landed
+    return landed(OUT)
+
+
+def _cfg(n: int, rounds: int):
+    from p2p_gossipprotocol_tpu.config import NetworkConfig
+
+    cfg_text = (f"127.0.0.1:8000\nbackend=jax\nn_peers={n}\n"
+                f"n_messages=16\navg_degree=8\nrounds={rounds}\n"
+                "local_ip=127.0.0.1\n")
+    with tempfile.NamedTemporaryFile("w", suffix=".txt",
+                                     delete=False) as f:
+        f.write(cfg_text)
+        path = f.name
+    try:
+        return NetworkConfig(path)
+    finally:
+        os.unlink(path)
+
+
+def _result_equal(a, b) -> bool:
+    """Bitwise: every state leaf + every metric array."""
+    for k in ("seen_w", "frontier_w", "alive_b", "byz_w", "key",
+              "round"):
+        if not np.array_equal(
+                np.asarray(jax.device_get(getattr(a.state, k))),
+                np.asarray(jax.device_get(getattr(b.state, k)))):
+            return False
+    for k in ("coverage", "deliveries", "frontier_size", "live_peers",
+              "evictions"):
+        if not np.array_equal(np.asarray(getattr(a, k)),
+                              np.asarray(getattr(b, k))):
+            return False
+    return True
+
+
+def bench_telemetry_ab(n: int, n_msgs: int, rounds: int, every: int,
+                       done):
+    tag = f"telemetry_ab_{n}"
+    if tag in done:
+        return
+    from p2p_gossipprotocol_tpu import telemetry
+    from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator,
+                                                build_aligned)
+    from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+    from p2p_gossipprotocol_tpu.utils.checkpoint import run_chunked
+
+    topo = build_aligned(seed=0, n=n, n_slots=16,
+                         degree_law="powerlaw", roll_groups=4,
+                         n_msgs=n_msgs)
+    sim = AlignedSimulator(topo=topo, n_msgs=n_msgs, mode="pushpull",
+                           churn=ChurnConfig(rate=0.05, kill_round=1),
+                           max_strikes=3, liveness_every=3, seed=0)
+    rec = telemetry.recorder()
+    prev = rec.enabled
+
+    def timed(on: bool):
+        rec.configure(enabled=on)
+        t0 = time.perf_counter()
+        res, *_ = run_chunked(sim, rounds, every=every)
+        return time.perf_counter() - t0, res
+
+    try:
+        timed(False)                        # warm the compile cache
+        off_wall, off_res = timed(False)
+        rec.reset()
+        on_wall, on_res = timed(True)
+        spans = len(rec.spans())
+        counters = rec.counters()
+    finally:
+        rec.configure(enabled=prev)
+    overhead = (on_wall - off_wall) / off_wall * 100
+    emit({"config": tag, "n_peers": n, "n_msgs": n_msgs,
+          "rounds": rounds, "check_every": every,
+          "ms_per_round_off": round(off_wall / rounds * 1e3, 3),
+          "ms_per_round_on": round(on_wall / rounds * 1e3, 3),
+          "obs_overhead_pct": round(overhead, 2),
+          "overhead_ok": overhead <= 3.0,
+          "parity_ok": _result_equal(off_res, on_res),
+          "spans_recorded": spans,
+          "roofline_frac": counters.get("roofline_frac"),
+          "model_drift_frac": counters.get("model_drift_frac"),
+          "rounds_total": counters.get("rounds_total")})
+
+
+def bench_serve_scrape(n: int, n_req: int, done):
+    tag = "serve_scrape"
+    if tag in done:
+        return
+    from p2p_gossipprotocol_tpu import telemetry
+    from p2p_gossipprotocol_tpu.serve.server import (ServeClient,
+                                                     ServeServer)
+    from p2p_gossipprotocol_tpu.serve.service import GossipService
+
+    rec = telemetry.recorder()
+    prev = rec.enabled
+    rec.configure(enabled=True)
+    rec.reset()
+    try:
+        cfg = _cfg(n, rounds=64)
+        svc = GossipService(cfg, slots=8, queue_max=n_req,
+                            target=0.99, rounds=64)
+        srv = ServeServer(svc, "127.0.0.1", 0).start()
+        client = ServeClient("127.0.0.1", srv.port, timeout=600)
+        t0 = time.perf_counter()
+        rids = [client.submit({"prng_seed": s}) for s in range(n_req)]
+        # capture WHILE the admitted requests are being served — a
+        # profile of an idle server summarizes zero ops (measured;
+        # that row was honest but useless), so the capture window must
+        # overlap live chunks
+        prof = client.profile(duration_s=1.0, top_n=10)
+        rows = [client.result(r, timeout=600) for r in rids]
+        wall = time.perf_counter() - t0
+        # live scrape while the server is still resident
+        text = client.metrics()
+        catalog = ["serve_rounds_total", "serve_requests_total",
+                   "serve_admitted_total", "serve_buckets",
+                   "serve_queue_depth", "rounds_total",
+                   "roofline_frac"]
+        seen = [c for c in catalog if f"gossip_{c} " in text]
+        client.drain()
+        client.close()
+        srv.stop()
+        emit({"config": tag, "n_peers": n, "n_req": n_req,
+              "wall_s": round(wall, 4),
+              "served": len(rows),
+              "metrics_bytes": len(text),
+              "counters_seen": seen,
+              "scrape_ok": len(seen) >= 5,
+              "profile_ops": len(prof["ops"]),
+              "profile_trace": os.path.basename(prof["trace"]),
+              "profile_ok": len(prof["ops"]) > 0})
+    finally:
+        rec.configure(enabled=prev)
+
+
+def bench_flight_salvage(n: int, done):
+    tag = "flight_salvage"
+    if tag in done:
+        return
+    from p2p_gossipprotocol_tpu import telemetry
+    from p2p_gossipprotocol_tpu.serve.service import GossipService
+
+    rec = telemetry.recorder()
+    prev = rec.enabled
+    rec.configure(enabled=True)
+    rec.reset()
+    ckpt = tempfile.mkdtemp(prefix="gossip_r13_salvage_")
+    try:
+        cfg = _cfg(n, rounds=64)
+        svc = GossipService(cfg, slots=4, queue_max=8, target=0.99,
+                            rounds=64, checkpoint_dir=ckpt).start()
+        rids = [svc.submit({"prng_seed": s}) for s in range(3)]
+        time.sleep(0.3)                     # let admission happen
+        svc.salvage(timeout=120)
+        dumps = [f for f in os.listdir(ckpt)
+                 if f.startswith("flight_")]
+        ok = False
+        kinds = {}
+        if dumps:
+            with open(os.path.join(ckpt, dumps[0])) as fp:
+                snap = json.load(fp)
+            kinds = snap.get("event_kinds", {})
+            ok = snap.get("reason") == "serve_salvage"
+        emit({"config": tag, "n_peers": n, "requests": len(rids),
+              "manifest_present": os.path.exists(
+                  os.path.join(ckpt, "serve_manifest.json")),
+              "dump_present": bool(dumps),
+              "dump_readable": ok,
+              "event_kinds": kinds})
+    finally:
+        rec.configure(enabled=prev)
+
+
+def main():
+    global OUT
+    backend = jax.default_backend()
+    on_tpu = backend in ("tpu", "axon")
+    OUT = _out_path(cpu=not on_tpu)
+    peers = [int(x) for x in os.environ.get(
+        "GOSSIP_R13_PEERS", "262144,1048576").split(",") if x]
+    n_msgs = int(os.environ.get("GOSSIP_R13_MSGS", "16"))
+    rounds = int(os.environ.get("GOSSIP_R13_ROUNDS", "12"))
+    every = int(os.environ.get("GOSSIP_R13_EVERY", "4"))
+    sn = int(os.environ.get("GOSSIP_R13_SERVE_PEERS", str(1 << 14)))
+    sreq = int(os.environ.get("GOSSIP_R13_SERVE_N", "6"))
+    done = _landed()
+    if "_backend" not in done:
+        emit({"config": "_backend", "backend": backend,
+              "peers": peers, "rounds": rounds})
+    for n in peers:
+        bench_telemetry_ab(n, n_msgs, rounds, every, done)
+    bench_serve_scrape(sn, sreq, done)
+    bench_flight_salvage(sn, done)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -109,7 +109,7 @@ record_fail() {
   fi
 }
 
-STEP_NAMES="bench mosaic_smoke measure_round4 measure_round5 measure_round6 measure_round7 measure_round8 measure_round9 measure_round10 measure_round11 measure_round12 baselines multihost longrun"
+STEP_NAMES="bench mosaic_smoke measure_round4 measure_round5 measure_round6 measure_round7 measure_round8 measure_round9 measure_round10 measure_round11 measure_round12 measure_round13 baselines multihost longrun"
 # Headline first: a short tunnel window must yield the most important
 # artifact.  bench keeps its file contract (ONE parsed line) and only
 # stamps when the line really came from the chip.  longrun is the
@@ -144,6 +144,11 @@ PY" ;;
     # sequential and batch-offline shapes, plus the Poisson
     # offered-load latency sweep (p50/p99 admission-to-result)
     measure_round12) echo "python benchmarks/measure_round12.py" ;;
+    # round-13: telemetry-plane overhead A/B (262k + 1M, on/off,
+    # bitwise parity) plus a live serve /metrics scrape and an
+    # on-demand bounded profile capture round-tripped through
+    # trace_top's summarizer
+    measure_round13) echo "python benchmarks/measure_round13.py" ;;
     baselines)      echo "python benchmarks/run_baselines.py" ;;
     multihost)
       # the multi-host step is DELEGATED to the runtime supervisor
@@ -178,6 +183,7 @@ step_tmo() {
     measure_round10) echo 3600 ;;
     measure_round11) echo 3600 ;;
     measure_round12) echo 3600 ;;
+    measure_round13) echo 3600 ;;
     baselines) echo 4800 ;;
     multihost) echo 1800 ;;
     longrun) echo 1800 ;;

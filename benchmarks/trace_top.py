@@ -11,70 +11,26 @@ per-term accounting.
 Accepts a trace directory (finds the newest *.trace.json.gz under it)
 or a direct file path.  Prints one JSON line per op: name, calls, total
 ms, share of the traced device time.
+
+The summarizer itself lives in
+``p2p_gossipprotocol_tpu/telemetry/traceview.py`` now (this script
+delegates), so the serve server's on-demand ``profile`` document
+round-trips captures through the SAME accounting — one summarizer, two
+surfaces.
 """
 from __future__ import annotations
 
-import glob
-import gzip
 import json
 import os
 import sys
-from collections import defaultdict
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-def find_trace(path: str) -> str:
-    if os.path.isfile(path):
-        return path
-    hits = sorted(glob.glob(os.path.join(path, "**", "*.trace.json.gz"),
-                            recursive=True), key=os.path.getmtime)
-    if not hits:
-        raise SystemExit(f"no *.trace.json.gz under {path!r}")
-    return hits[-1]
+from p2p_gossipprotocol_tpu.telemetry.traceview import (  # noqa: E402
+    find_trace, summarize)
 
-
-def summarize(trace_file: str, top_n: int = 20) -> list[dict]:
-    with gzip.open(trace_file, "rt") as f:
-        doc = json.load(f)
-    events = doc.get("traceEvents", [])
-    # keep complete ('X') events from device lanes; host python lanes
-    # carry huge nested spans that would double-count
-    dur_by_name: dict[str, float] = defaultdict(float)
-    calls: dict[str, int] = defaultdict(int)
-    pid_names = {e.get("pid"): e.get("args", {}).get("name", "")
-                 for e in events
-                 if e.get("ph") == "M" and e.get("name") == "process_name"}
-    tid_names = {(e.get("pid"), e.get("tid")):
-                 e.get("args", {}).get("name", "")
-                 for e in events
-                 if e.get("ph") == "M" and e.get("name") == "thread_name"}
-    # Device traces nest module/step spans around the op spans on the
-    # same pid — summing every lane would double-count device time and
-    # halve each kernel's share.  Keep ONLY the "XLA Ops" lanes when
-    # the trace has them (TPU traces do); fall back to the
-    # everything-but-python filter otherwise (CPU rehearsal traces).
-    op_lanes = {k for k, v in tid_names.items() if "XLA Ops" in v}
-    for e in events:
-        if e.get("ph") != "X" or "dur" not in e:
-            continue
-        if op_lanes:
-            if (e.get("pid"), e.get("tid")) not in op_lanes:
-                continue
-        else:
-            lane = pid_names.get(e.get("pid"), "")
-            if "python" in lane.lower():
-                continue
-        name = e.get("name", "?")
-        if name.startswith("$"):   # python source spans ($file.py:line)
-            continue
-        dur_by_name[name] += e["dur"]          # microseconds
-        calls[name] += 1
-    total = sum(dur_by_name.values()) or 1.0
-    rows = [{"op": k, "calls": calls[k],
-             "total_ms": round(v / 1e3, 3),
-             "share": round(v / total, 4)}
-            for k, v in sorted(dur_by_name.items(),
-                               key=lambda kv: -kv[1])[:top_n]]
-    return rows
+__all__ = ["find_trace", "summarize", "main"]
 
 
 def main() -> int:

@@ -142,6 +142,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "checkpoint (needs --checkpoint-dir for "
                         "resume-instead-of-restart).  Config twins: "
                         "supervise=1 and the supervise_* keys")
+    p.add_argument("--telemetry", action="store_true",
+                   help="jax mode: turn on the flight-recorder "
+                        "telemetry plane (telemetry/): nested spans "
+                        "(run > chunk > exchange; serve request "
+                        "ledgers), live counters + roofline_frac "
+                        "reconciled against traffic_model(), and "
+                        "atomic flight-recorder dumps on crash / "
+                        "SIGTERM salvage / demand.  Observational by "
+                        "contract: zero device computation, results "
+                        "bitwise-identical on or off "
+                        "(docs/OBSERVABILITY.md).  Config twins: "
+                        "telemetry=1 and the telemetry_* keys; env "
+                        "twin GOSSIP_TELEMETRY=1")
     p.add_argument("--metrics-jsonl", default=None, metavar="PATH",
                    help="write per-round metrics as JSONL")
     p.add_argument("--profile-dir", default=None, metavar="DIR",
@@ -259,6 +272,16 @@ def _run_jax(cfg: NetworkConfig, args) -> int:
                 graph_backend=graph_backend)
     done = len(res.infected if cfg.mode == "sir" else res.coverage)
     if stop["flag"] and done < rounds:
+        # flight-recorder dump alongside the exit-75 salvage: the
+        # preempted run's spans/events/counters land next to its
+        # checkpoint (or the configured telemetry dump dir)
+        from p2p_gossipprotocol_tpu import telemetry
+
+        telemetry.event("salvage", kind_detail="cli",
+                        rounds_done=done, rounds=rounds)
+        telemetry.dump("sigterm_salvage",
+                       directory=(cfg.telemetry_dump_dir
+                                  or args.checkpoint_dir))
         print(f"[checkpoint] salvage checkpoint covers {done}/{rounds} "
               "rounds — exiting resumable (75)", file=sys.stderr)
         return EX_RESUMABLE
@@ -324,6 +347,14 @@ def _run_fleet(sweep, cfg, args, rounds) -> int:
     print(json.dumps(summary))
     if res.interrupted:
         if args.checkpoint_dir and len(res.rows) < res.n_scenarios:
+            from p2p_gossipprotocol_tpu import telemetry
+
+            telemetry.event("salvage", kind_detail="fleet",
+                            scenarios_done=len(res.rows),
+                            n_scenarios=res.n_scenarios)
+            telemetry.dump("sigterm_salvage",
+                           directory=(cfg.telemetry_dump_dir
+                                      or args.checkpoint_dir))
             print(f"[checkpoint] sweep salvaged after {len(res.rows)}/"
                   f"{res.n_scenarios} scenarios — exiting resumable "
                   "(75)", file=sys.stderr)
@@ -554,6 +585,17 @@ def main(argv: list[str] | None = None) -> int:
     except ConfigError as e:
         print(f"Error: {e}", file=sys.stderr)
         return 1
+
+    # telemetry plane: configure the process recorder from the config's
+    # telemetry_* keys (--telemetry / GOSSIP_TELEMETRY=1 force-enable),
+    # and chain the crash-dump hook so an uncaught exception leaves a
+    # flight-recorder dump — every post-mortem ships its own trace
+    from p2p_gossipprotocol_tpu import telemetry
+
+    rec = telemetry.configure_from_config(cfg, force=args.telemetry)
+    if rec.enabled or rec.dump_dir:
+        rec.install_crash_dump(
+            directory=rec.dump_dir or cfg.checkpoint_dir or None)
 
     if args.backend:
         cfg.backend = args.backend

@@ -219,6 +219,17 @@ _SIM_INT_KEYS = {
     "supervise_devs_per_proc": "supervise_devs_per_proc",
     "supervise_max_failures": "supervise_max_failures",
     "supervise_min_workers": "supervise_min_workers",
+    # Telemetry plane (telemetry/; docs/OBSERVABILITY.md): telemetry=1
+    # turns on spans + counters + the live roofline (the typed event
+    # ledger is always on — clamps and fallbacks must survive into any
+    # post-mortem).  Observational by contract: zero device
+    # computation, bitwise-identical results on or off, and the
+    # telemetry_* keys are EXCLUDED from checkpoint fingerprints
+    # (engines.config_keys) — telemetry watches a run, never steers
+    # it.  telemetry_ring bounds the flight recorder's span/event
+    # rings.  CLI twin: --telemetry; env twin: GOSSIP_TELEMETRY=1.
+    "telemetry": "telemetry",
+    "telemetry_ring": "telemetry_ring",
 }
 _SIM_FLOAT_KEYS = {
     "er_p": "er_p",
@@ -285,6 +296,10 @@ _SIM_STR_KEYS = {
     # the single-process-spmd chief rehearsal where multi-process
     # collectives don't exist), or force either.
     "supervise_spmd": "supervise_spmd",
+    # Telemetry plane: where flight-recorder dumps land (crash, SIGTERM
+    # salvage, on demand); empty = checkpoint_dir when one exists, else
+    # no automatic dump destination.
+    "telemetry_dump_dir": "telemetry_dump_dir",
 }
 
 
@@ -402,6 +417,10 @@ class NetworkConfig:
         self.serve_rounds = 0            # per-scenario cap; 0 = rounds/64
         self.serve_target = 0.99         # retirement coverage target
         self.serve_results = ""          # served-rows JSONL (append)
+        # Telemetry plane (telemetry/; docs/OBSERVABILITY.md)
+        self.telemetry = 0               # 1 = spans+counters+roofline on
+        self.telemetry_ring = 4096       # flight-recorder ring bound
+        self.telemetry_dump_dir = ""     # dump destination ("" = ckpt dir)
         # Self-healing supervision (runtime/supervisor.py)
         self.supervise = 0               # 1 = run under the supervisor
         self.supervise_workers = 2       # worker processes in the job
@@ -533,11 +552,11 @@ class NetworkConfig:
                   "sweep_max_batch", "sweep_pad_peers",
                   "supervise", "supervise_max_failures",
                   "supervise_grace_s", "supervise_deadline_s",
-                  "serve", "serve_rounds"):
+                  "serve", "serve_rounds", "telemetry"):
             if getattr(self, k) < 0:
                 raise ConfigError(f"{k} must be non-negative")
         for k in ("serve_slots", "serve_queue_max", "serve_max_buckets",
-                  "serve_chunk"):
+                  "serve_chunk", "telemetry_ring"):
             if getattr(self, k) < 1:
                 raise ConfigError(f"{k} must be >= 1")
         if not (0.0 < self.serve_target < 1.0):

@@ -153,6 +153,13 @@ def probe_backend() -> bool:
               "failed) — simulating on CPU instead (results are "
               "platform-independent; only speed differs)",
               file=sys.stderr)
+        # typed ledger entry (telemetry plane): the probe fallback is a
+        # degradation every post-mortem must be able to see
+        from p2p_gossipprotocol_tpu import telemetry
+
+        telemetry.event(
+            "probe_fallback",
+            detail="accelerator backend unavailable — pinned CPU")
         jax.config.update("jax_platforms", "cpu")
     _PROBE_STATE.append(not ok)
     return not ok
@@ -187,9 +194,13 @@ def config_keys(cfg, n_peers: int | None = None) -> dict:
     many worker processes, what deadlines), never its trajectory — a
     checkpoint written under supervision must resume unsupervised and
     vice versa, and a shrink-to-survivors recovery must not read as
-    fingerprint drift (runtime/supervisor.py).  Everything that picks
-    the overlay, the model, the randomness chain, or the fault
-    schedule is included."""
+    fingerprint drift (runtime/supervisor.py).  The ``telemetry_*``
+    keys are excluded for the same reason: telemetry is observational
+    by contract (zero device computation, bitwise-identical results on
+    or off — tests/test_telemetry.py), so a checkpoint written with
+    telemetry on must resume with it off and vice versa.  Everything
+    that picks the overlay, the model, the randomness chain, or the
+    fault schedule is included."""
     return {
         "n_peers": n_peers or cfg.n_peers or len(cfg.seed_nodes),
         "n_messages": cfg.n_messages or cfg.max_message_count,
@@ -235,7 +246,28 @@ def build_simulator(cfg, *, n_peers: int | None = None,
     CLI passes its flag-resolved values.  ``clamps`` (aligned engines
     only) collects any configured value the engine had to reduce —
     surfaced by every caller, never silent.
+
+    This wrapper is also THE clamp-ledger chokepoint: every clamp any
+    engine records while resolving (auto-select degrades, frontier/
+    hier/overlap illegal combos, engine ceilings, the CPU mesh
+    fallback) emits exactly one typed ``clamp`` event through the
+    telemetry ledger (telemetry.record_clamps), whether or not the
+    caller passed its own ``clamps`` list — one queryable stream
+    instead of N scattered strings.
     """
+    from p2p_gossipprotocol_tpu import telemetry
+
+    clamps = [] if clamps is None else clamps
+    n0 = len(clamps)
+    try:
+        return _build_simulator(cfg, n_peers=n_peers,
+                                mesh_devices=mesh_devices,
+                                msg_shards=msg_shards, clamps=clamps)
+    finally:
+        telemetry.record_clamps(clamps[n0:], scope="build_simulator")
+
+
+def _build_simulator(cfg, *, n_peers, mesh_devices, msg_shards, clamps):
     fell_back = probe_backend()
     mesh_devices = (cfg.mesh_devices if mesh_devices is None
                     else mesh_devices)

@@ -51,42 +51,24 @@ _STATE_LEAVES = ("seen_w", "frontier_w", "alive_b", "byz_w", "key",
 
 def append_rows(path: str, rows: list[dict]) -> None:
     """Concurrency-safe results-JSONL append: O_APPEND + ONE ``write()``
-    per row.  POSIX makes an O_APPEND write atomic with respect to the
-    file offset, so interleaved writers (serve workers finishing
-    scenarios, the salvage path flushing rows, a resumed sweep) can
-    never splice bytes inside each other's rows — the old
-    whole-table-rewrite discipline was atomic but single-writer, and
-    the serving plane has many.  A row never contains a newline
-    (``json.dumps`` default), so one row is exactly one line."""
-    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
-    try:
-        for r in rows:
-            line = (json.dumps(r) + "\n").encode()
-            os.write(fd, line)
-    finally:
-        os.close(fd)
+    per row, so interleaved writers (serve workers finishing scenarios,
+    the salvage path flushing rows, a resumed sweep) can never splice
+    bytes inside each other's rows.  The writer itself lives in
+    ``utils/logging.append_jsonl`` now — shared with NodeLogger and the
+    telemetry plane, one line discipline for every concurrent-append
+    surface in the repo."""
+    from p2p_gossipprotocol_tpu.utils.logging import append_jsonl
+
+    append_jsonl(path, rows)
 
 
 def read_rows(path: str) -> list[dict]:
-    """Read a results-JSONL table, skipping torn lines.  A writer
-    crashing mid-``write()`` can leave at most one partial row (no
-    trailing newline, or truncated JSON); the reader drops any line
-    that does not parse instead of failing the whole table — the
-    torn-line twin of the checkpoint layer's torn-write discipline."""
-    rows = []
-    try:
-        with open(path, "rb") as fp:
-            data = fp.read()
-    except OSError:
-        return rows
-    for ln in data.split(b"\n"):
-        if not ln.strip():
-            continue
-        try:
-            rows.append(json.loads(ln))
-        except (ValueError, UnicodeDecodeError):
-            continue               # torn row (crash mid-write): skip
-    return rows
+    """Read a results-JSONL table, skipping torn lines
+    (``utils/logging.read_jsonl`` — the shared torn-line-skipping
+    reader matching :func:`append_rows`' writer)."""
+    from p2p_gossipprotocol_tpu.utils.logging import read_jsonl
+
+    return read_jsonl(path)
 
 
 @dataclass

@@ -374,10 +374,21 @@ class FleetBucket:
                 break                      # bucket early-exit
             step = min(check_every, rounds - rounds_done)
             fn = self._chunk_fn(step, target)
-            state, topo, done, ys, dhist = fn(state, topo, done,
-                                              self._seeds, self._srcs)
-            ys = {k: np.asarray(jax.device_get(ys[k]))
-                  for k in METRIC_KEYS}
+            # telemetry: host-side span + counters around the already-
+            # scheduled chunk — never inside the compiled program, so
+            # trace_count and results are identical on or off
+            from p2p_gossipprotocol_tpu import telemetry
+
+            with telemetry.span("chunk", kind="fleet", rounds=step,
+                                batch=B, start_round=rounds_done):
+                state, topo, done, ys, dhist = fn(state, topo, done,
+                                                  self._seeds,
+                                                  self._srcs)
+                ys = {k: np.asarray(jax.device_get(ys[k]))
+                      for k in METRIC_KEYS}
+            telemetry.counter_add("fleet_rounds_total", step)
+            telemetry.counter_add("fleet_scenario_rounds_total",
+                                  step * B)
             dh = np.asarray(jax.device_get(dhist))       # [step, B] bool
             hist = {k: np.concatenate([hist[k], ys[k]]) for k in ys}
             # first round (1-indexed, global) each scenario converged

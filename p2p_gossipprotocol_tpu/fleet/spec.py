@@ -55,7 +55,9 @@ _RESERVED = {"engine", "mesh_devices", "msg_shards", "sweep_file",
              # dicts through this same table)
              "serve", "serve_slots", "serve_queue_max",
              "serve_max_buckets", "serve_chunk", "serve_rounds",
-             "serve_target", "serve_results"}
+             "serve_target", "serve_results",
+             # telemetry watches the PROCESS, never one scenario
+             "telemetry", "telemetry_ring", "telemetry_dump_dir"}
 
 
 def _attr_for(key: str) -> str | None:

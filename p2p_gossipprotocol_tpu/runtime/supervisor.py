@@ -51,6 +51,7 @@ import sys
 import time
 from dataclasses import dataclass, field
 
+from p2p_gossipprotocol_tpu import telemetry
 from p2p_gossipprotocol_tpu.utils.checkpoint import (EX_RESUMABLE,
                                                      CheckpointError,
                                                      latest_intact)
@@ -440,6 +441,10 @@ class Supervisor:
         try:
             while True:
                 attempt += 1
+                if attempt > 1:
+                    telemetry.counter_add("supervise_restarts_total")
+                telemetry.gauge_set("supervise_survivors",
+                                    len(survivors))
                 resume, resumed_round = self._resume_round()
                 port = _free_port()
                 # stale heartbeats from the previous attempt must not
@@ -497,6 +502,12 @@ class Supervisor:
                     if mode == "distributed" and spmd == "auto":
                         spmd = "chief"
                         plan.chief_only = True
+                        # the spmd fallback is a recorded degradation —
+                        # one typed ledger entry, like every clamp
+                        telemetry.event(
+                            "spmd_fallback",
+                            detail="distributed backend impossible — "
+                                   "single-process-spmd (chief) mode")
                         self.log("[supervise] distributed backend "
                                  "impossible here — falling back to "
                                  "single-process-spmd (chief) mode")
@@ -507,6 +518,16 @@ class Supervisor:
                 failure = WorkerFailure(rank=rank, kind=kind,
                                         detail=detail,
                                         detected_at=time.monotonic())
+                # worker death is a flight-recorder moment: the typed
+                # event + an atomic dump into the run dir, so the
+                # post-mortem of the TORN attempt ships its own trace
+                telemetry.event("worker_death", rank=rank,
+                                failure_kind=kind,
+                                detail=(detail or "")[-500:],
+                                attempt=attempt)
+                telemetry.counter_add("supervise_failures_total")
+                telemetry.dump(f"worker_{kind}",
+                               directory=self.plan.run_dir)
                 self.log(f"[supervise] rank {rank} {kind}: "
                          f"{detail.splitlines()[-1][:200] if detail else ''}")
                 self._reap_job()
@@ -529,6 +550,7 @@ class Supervisor:
                                         resumed_round=0,
                                         attempt=attempt + 1)
                 recoveries.append(pending)
+                telemetry.counter_add("supervise_recoveries_total")
         finally:
             # orphan-proof: no worker outlives the supervisor, however
             # run() exits (return, exception, KeyboardInterrupt)
@@ -559,10 +581,13 @@ class Supervisor:
                            or (hb["phase"] == "run"
                                and hb["round"] > pending.resumed_round)):
                     pending.mttr_s = now - pending.failure.detected_at
+                    telemetry.gauge_set("supervise_mttr_s",
+                                        round(pending.mttr_s, 3))
                     self.log(f"[supervise] recovered: round "
                              f"{hb['round']} on {len(survivors)} "
                              f"worker(s), MTTR {pending.mttr_s:.2f}s")
 
+            hb_ages: list[float] = []
             for rank in survivors:
                 if rank in done_ranks:
                     continue
@@ -600,6 +625,7 @@ class Supervisor:
                     # monotonic-ish local disk; map to monotonic time
                     hb["_mono"] = now - max(0.0, time.time()
                                             - hb["mtime"])
+                    hb_ages.append(now - hb["_mono"])
                 if now > self._deadline_for(hb, attempt_t0):
                     # hung (wedged collective, SIGSTOP, dead tunnel):
                     # SIGKILL — a stopped process ignores everything
@@ -614,6 +640,11 @@ class Supervisor:
                              if hb else "no heartbeat ever written")
                     return ("hung",
                             f"missed its deadline ({stamp})", rank)
+            if hb_ages:
+                # the operator gauge: how stale is the stalest live
+                # worker's heartbeat right now
+                telemetry.gauge_set("supervise_heartbeat_age_s",
+                                    round(max(hb_ages), 3))
             time.sleep(plan.poll_s)
 
 
@@ -709,6 +740,9 @@ def supervise_from_config(cfg, *, config_path: str, rounds: int,
         run_dir = os.path.join(ckpt, "supervise")
     else:
         run_dir = tempfile.mkdtemp(prefix="gossip_supervise_")
+    # the supervisor's own telemetry (gauges, worker-death dumps) —
+    # still jax-free; workers configure themselves from the same config
+    telemetry.configure_from_config(cfg)
     plan = plan_from_config(cfg, config_path=config_path, rounds=rounds,
                             run_dir=run_dir, n_peers=n_peers,
                             checkpoint_dir=checkpoint_dir,

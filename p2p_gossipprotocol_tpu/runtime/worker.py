@@ -192,6 +192,13 @@ def _run_supervised(args, cfg, hb_path: str, *, mesh_devices: int,
         # interrupted before completion: 75 iff a salvage checkpoint
         # actually landed at the last chunk boundary (the CLI contract)
         if res is not None and ckpt_dir:
+            # the flight recorder rides the salvage: spans/counters of
+            # the interrupted run land next to the checkpoint
+            from p2p_gossipprotocol_tpu import telemetry
+
+            telemetry.event("salvage", kind_detail="worker",
+                            rank=args.rank, rounds_done=done_rounds)
+            telemetry.dump("worker_salvage", directory=args.run_dir)
             return EX_RESUMABLE
         return 1
 
@@ -236,6 +243,9 @@ def main(argv=None) -> int:
     except ConfigError as e:
         print(f"[worker] {e}", file=sys.stderr)
         return 1
+    from p2p_gossipprotocol_tpu import telemetry
+
+    telemetry.configure_from_config(cfg)
     if cfg.mode == "sir":
         print("[worker] supervision covers the gossip modes (the SIR "
               "engines have no sharded checkpoint contract yet)",

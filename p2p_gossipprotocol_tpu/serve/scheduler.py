@@ -90,14 +90,21 @@ def resolve_request(base_cfg, overrides: dict, rid: int,
     padding record) — which is what makes the serving plane's bitwise
     contract the fleet's, not a new one.  Raises :class:`ServeReject`
     with the resolution error as the reason."""
+    from p2p_gossipprotocol_tpu import telemetry
+
     try:
         spec = build_scenarios(base_cfg, [overrides], n_peers=n_peers,
                                pad_peers=pad_peers)[0]
     except ConfigError as e:
+        telemetry.event("reject", site="resolve",
+                        detail=str(e.message), request=rid)
         raise ServeReject(f"bad scenario: {e.message}") from e
     # build_scenarios numbers specs by sweep position; a served request
     # is identified by its rid across resumes
     spec.index = rid
+    # the serve admission path bypasses engines.build_simulator, so it
+    # is its own clamp-ledger chokepoint (same one-event-per-clamp rule)
+    telemetry.record_clamps(spec.clamps, scope=f"request:{rid}")
     return spec
 
 
@@ -131,12 +138,16 @@ class Scheduler:
         (draining server, full queue, unresolvable scenario).  ``rid``
         is only passed by resume re-hydration, which must keep the
         original ids."""
+        from p2p_gossipprotocol_tpu import telemetry
+
         with self._lock:
             if not self._accepting:
                 self.n_rejected += 1
+                telemetry.counter_add("serve_rejected_total")
                 raise ServeReject("server is draining (no new work)")
             if len(self.queue) >= self.queue_max:
                 self.n_rejected += 1
+                telemetry.counter_add("serve_rejected_total")
                 raise ServeReject(
                     f"queue full ({self.queue_max} waiting; retry "
                     "later or raise serve_queue_max)")

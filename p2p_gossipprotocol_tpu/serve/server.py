@@ -15,6 +15,17 @@ request      fields                                 response
                                                     ``pending`` / error
 ``stats``    —                                      ``stats`` (p50/p99
                                                     latency + occupancy)
+``metrics``  —                                      ``metrics`` (the
+                                                    Prometheus-style
+                                                    counter/gauge text
+                                                    page — the scrape
+                                                    surface)
+``profile``  optional ``duration_s`` (clamped to    ``profile`` (trace
+             [0.1, 30]), ``top_n``                  path + top ops) or
+                                                    ``error``
+``flight``   —                                      ``flight`` (the
+                                                    flight-recorder
+                                                    snapshot, on demand)
 ``drain``    —                                      ``drained`` (stats),
                                                     then the server stops
 ===========  =====================================  ====================
@@ -163,6 +174,33 @@ class ServeServer:
         elif op == "stats":
             self._reply(conn, {"type": "stats",
                                **self.service.stats()})
+        elif op == "metrics":
+            from p2p_gossipprotocol_tpu import telemetry
+
+            self._reply(conn, {"type": "metrics",
+                               "text": telemetry.recorder()
+                               .render_metrics()})
+        elif op == "flight":
+            from p2p_gossipprotocol_tpu import telemetry
+
+            self._reply(conn, {"type": "flight",
+                               "snapshot": telemetry.recorder()
+                               .snapshot()})
+        elif op == "profile":
+            try:
+                res = self.service.profile_capture(
+                    duration_s=float(doc.get("duration_s", 2.0)),
+                    top_n=int(doc.get("top_n", 20)))
+            except ServeReject as e:
+                self._reply(conn, {"type": "error", "reason": e.reason})
+                return True
+            except Exception as e:  # noqa: BLE001 — capture failed, say so
+                self._reply(conn, {"type": "error",
+                                   "reason": f"profile capture failed: "
+                                             f"{type(e).__name__}: "
+                                             f"{e}"})
+                return True
+            self._reply(conn, {"type": "profile", **res})
         elif op == "drain":
             stats = self.service.drain()
             self._reply(conn, {"type": "drained", **stats})
@@ -212,6 +250,30 @@ class ServeClient:
 
     def stats(self) -> dict:
         return self._rpc({"type": "stats"})
+
+    def metrics(self) -> str:
+        """The counter/gauge text page (the scrape surface)."""
+        resp = self._rpc({"type": "metrics"})
+        if resp.get("type") != "metrics":
+            raise RuntimeError(resp.get("reason", str(resp)))
+        return resp["text"]
+
+    def flight(self) -> dict:
+        """The flight-recorder snapshot, on demand."""
+        resp = self._rpc({"type": "flight"})
+        if resp.get("type") != "flight":
+            raise RuntimeError(resp.get("reason", str(resp)))
+        return resp["snapshot"]
+
+    def profile(self, duration_s: float = 2.0, top_n: int = 20) -> dict:
+        """On-demand bounded profiler capture; returns
+        ``{"trace", "duration_s", "ops"}`` (see
+        ``GossipService.profile_capture``)."""
+        resp = self._rpc({"type": "profile", "duration_s": duration_s,
+                          "top_n": top_n})
+        if resp.get("type") != "profile":
+            raise RuntimeError(resp.get("reason", str(resp)))
+        return resp
 
     def drain(self) -> dict:
         return self._rpc({"type": "drain"})

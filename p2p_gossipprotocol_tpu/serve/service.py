@@ -52,6 +52,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from p2p_gossipprotocol_tpu import telemetry
 from p2p_gossipprotocol_tpu.fleet.engine import (METRIC_DTYPES,
                                                  METRIC_KEYS, FleetBucket,
                                                  _unstack_topology)
@@ -258,6 +259,9 @@ class GossipService:
         # _resume before the loop starts, the serving loop after — so
         # handler threads never iterate buckets the loop is mutating
         self._occupancy: dict = {}
+        # on-demand bounded jax.profiler capture (the serve ``profile``
+        # document): one at a time, never concurrent with itself
+        self._profile_lock = threading.Lock()
         if resume:
             self._resume()
         self._publish_occupancy()
@@ -340,6 +344,13 @@ class GossipService:
             "chunk_retraces": sum(b.fleet.trace_count
                                   for b in self.buckets),
         }
+        # /metrics gauges mirror the snapshot (no-ops when telemetry
+        # is off)
+        telemetry.gauge_set("serve_buckets", self._occupancy["buckets"])
+        telemetry.gauge_set("serve_slots_free",
+                            self._occupancy["slots_free"])
+        telemetry.gauge_set("serve_queue_depth",
+                            len(self.scheduler.queue))
 
     def stats(self) -> dict:
         """The ``/stats`` payload: scheduler ledger + resident-bucket
@@ -349,6 +360,47 @@ class GossipService:
         out = self.scheduler.stats()
         out.update(self._occupancy)
         return out
+
+    def profile_capture(self, duration_s: float = 2.0,
+                        top_n: int = 20,
+                        log_dir: str | None = None) -> dict:
+        """On-demand BOUNDED ``jax.profiler`` capture of the running
+        service (the serve ``profile`` document): trace for
+        ``duration_s`` seconds (clamped to [0.1, 30] — a profiler left
+        running is an outage, not an observation) while the serving
+        loop keeps dispatching, then summarize the capture through the
+        same top-ops accounting the offline post-mortems use
+        (telemetry.traceview.summarize == benchmarks/trace_top.py).
+
+        Returns ``{"trace": path, "duration_s": s, "ops": rows}``.
+        One capture at a time — the profiler is process-global; a
+        concurrent request raises :class:`ServeReject` instead of
+        corrupting the in-flight capture."""
+        import tempfile
+
+        from p2p_gossipprotocol_tpu.telemetry.traceview import (
+            find_trace, summarize)
+
+        duration_s = min(max(float(duration_s), 0.1), 30.0)
+        if not self._profile_lock.acquire(blocking=False):
+            raise ServeReject("a profile capture is already running "
+                              "(the profiler is process-global; retry "
+                              "when it finishes)")
+        try:
+            d = log_dir or tempfile.mkdtemp(prefix="gossip_profile_")
+            jax.profiler.start_trace(d)
+            try:
+                time.sleep(duration_s)
+            finally:
+                jax.profiler.stop_trace()
+            trace = find_trace(d)
+            ops = summarize(trace, top_n=max(1, int(top_n)))
+        finally:
+            self._profile_lock.release()
+        telemetry.event("profile_capture", duration_s=duration_s,
+                        trace=trace, n_ops=len(ops))
+        telemetry.counter_add("profile_captures_total")
+        return {"trace": trace, "duration_s": duration_s, "ops": ops}
 
     def drain(self, timeout: float | None = None) -> dict:
         """Stop accepting, serve everything already admitted or queued,
@@ -406,6 +458,7 @@ class GossipService:
                 continue
             slot = b.admit(req)
             self.scheduler.mark_admitted(req)
+            telemetry.counter_add("serve_admitted_total")
             n += 1
             if self.log:
                 self.log(f"[serve] request {req.rid} -> bucket "
@@ -442,6 +495,18 @@ class GossipService:
             row[f"rounds_to_{self.target:g}"] = int(
                 res.rounds_to(self.target))
         self.scheduler.finish(req, row, result=res)
+        # request span with a STABLE id (request:<rid> — rids survive a
+        # salvage/resume) carrying the enqueue→admit→converge→result
+        # ledger the scheduler stamped
+        lat = req.latency_ms()
+        telemetry.recorder().span_record(
+            "request", (req.t_result - req.t_enqueue),
+            span_id=f"request:{req.rid}", bucket=bucket_id,
+            rounds_run=int(r_i), converged=bool(occ.converged > 0),
+            **lat)
+        telemetry.counter_add("serve_requests_total")
+        if occ.converged > 0:
+            telemetry.counter_add("serve_converged_total")
         if self.results_path:
             from p2p_gossipprotocol_tpu.fleet.driver import append_rows
 
@@ -469,13 +534,20 @@ class GossipService:
                     # the serve_rounds cap (chunk boundaries need not
                     # divide it)
                     step = b.next_step(self.rounds)
-                    ys, dhist = b.dispatch(step)
-                    # overlap seam: stage the next admissions while the
-                    # chunk executes; collect() below is the sync point
-                    self._stage_pending()
-                    for slot, occ, res in b.collect(ys, dhist,
-                                                    self.rounds,
-                                                    step=step):
+                    with telemetry.span(
+                            "chunk", kind="serve", rounds=step,
+                            bucket=self.buckets.index(b),
+                            occupants=sum(
+                                o is not None for o in b.occupants)):
+                        ys, dhist = b.dispatch(step)
+                        # overlap seam: stage the next admissions while
+                        # the chunk executes; collect() below is the
+                        # sync point
+                        self._stage_pending()
+                        retired = b.collect(ys, dhist, self.rounds,
+                                            step=step)
+                    telemetry.counter_add("serve_rounds_total", step)
+                    for slot, occ, res in retired:
                         self._finish(self.buckets.index(b), occ, res)
                 self._publish_occupancy()
         except Exception as e:  # noqa: BLE001 — surface via result()
@@ -561,6 +633,13 @@ class GossipService:
             })
         _write_atomic(self._manifest_path(),
                       json.dumps(manifest, sort_keys=True))
+        # flight-recorder dump ALONGSIDE the salvage (the exit-75
+        # contract grew a black box): the post-mortem of a preempted
+        # server ships its own spans/events/counters
+        telemetry.event("salvage", kind_detail="serve",
+                        buckets=len(manifest["buckets"]),
+                        queued=len(manifest["queued"]))
+        telemetry.dump("serve_salvage", directory=self.checkpoint_dir)
         if self.log:
             n_live = sum(len(e["occupants"])
                          for e in manifest["buckets"])

@@ -1,0 +1,167 @@
+"""Live roofline: per-chunk reconciliation of census vs traffic model.
+
+``bench.py`` computes ``roofline_frac`` once, offline, from a finished
+run's wall clock.  A resident server and a supervised long run need the
+same number LIVE: after every chunk, this tracker folds the chunk's
+already-materialized in-kernel census (coverage, deliveries, frontier
+size — metrics the engines emit anyway, so tracking adds zero device
+work) and the engine's analytic per-term byte accounting
+(``traffic_model()``, the Sparse-Allreduce-style comms-cost model) into
+cumulative counters and two headline gauges:
+
+* ``roofline_frac`` — achieved fraction of the HBM roof, the bench
+  definition exactly: model bytes moved over measured wall, divided by
+  ``roof_gb_s`` (env ``GOSSIP_ROOF_GB_S`` > ``GOSSIP_BENCH_ROOF_GB_S``
+  > 800, the v5e default the repo has always quoted);
+* ``model_drift_frac`` — modeled-vs-achieved drift: the dense model
+  prices every round at full frontier width, while the live census
+  knows the actual frontier; the gauge is the relative gap between the
+  dense accounting and the census-informed accounting
+  (``traffic_model(frontier_fill=live fill)``), i.e. how far reality
+  has drifted below the model's upper bound.  0 while the frontier is
+  dense, growing as the run enters the sparse regime.
+
+The per-chunk ``exchange`` span is model-attributed: the host cannot
+observe in-jit phases, so the span's duration is the chunk wall scaled
+by the exchange terms' share of modeled bytes, and it carries
+``modeled=True`` — documented, never passed off as a measurement
+(docs/OBSERVABILITY.md "Span taxonomy").
+"""
+
+from __future__ import annotations
+
+import os
+
+from p2p_gossipprotocol_tpu.telemetry.recorder import recorder
+
+#: default HBM roof (GB/s) — the v5e number bench.py's roofline_frac
+#: divides by; override with GOSSIP_ROOF_GB_S (or the bench twin).
+ROOF_GB_S_DEFAULT = 800.0
+
+
+def _roof_gb_s() -> float:
+    for knob in ("GOSSIP_ROOF_GB_S", "GOSSIP_BENCH_ROOF_GB_S"):
+        raw = os.environ.get(knob, "").strip()
+        if raw:
+            try:
+                return float(raw)
+            except ValueError:
+                continue
+    return ROOF_GB_S_DEFAULT
+
+
+class RooflineTracker:
+    """Per-chunk counter aggregation + live roofline for one run (see
+    module docstring).  Construct via :meth:`for_sim`, which returns
+    None for engines without a traffic model (the edges family) —
+    callers then skip tracking entirely."""
+
+    def __init__(self, model_fn, dense_bytes_round: float,
+                 n_peers: int):
+        self._model_fn = model_fn           # frontier_fill -> terms dict
+        self.dense_bytes_round = float(dense_bytes_round)
+        self.n_peers = max(1, int(n_peers))
+        self.roof_gb_s = _roof_gb_s()
+        self.rounds = 0
+        self.wall_s = 0.0
+        self.model_bytes = 0.0              # dense accounting
+        self.census_bytes = 0.0             # fill-informed accounting
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def for_sim(cls, sim) -> "RooflineTracker | None":
+        """A tracker for ``sim`` when it can price itself (the aligned
+        family — sharded wrappers expose the model through ``_inner``),
+        else None."""
+        inner = getattr(sim, "_inner", sim)
+        model = getattr(inner, "traffic_model", None)
+        if model is None:
+            return None
+        n_shards = int(getattr(sim, "n_shards", 1) or 1)
+
+        def model_fn(fill=None):
+            return model(frontier_fill=fill, n_shards=n_shards)
+
+        try:
+            dense = float(model_fn()["total"])
+        except Exception:  # noqa: BLE001 — a sim that cannot price
+            return None    # itself is tracked by spans alone
+        topo = getattr(inner, "topo", None)
+        n_peers = int(getattr(topo, "n_peers", 0) or 1)
+        return cls(model_fn, dense, n_peers)
+
+    # ------------------------------------------------------------------
+    def update(self, rounds: int, wall_s: float, metrics: dict) -> None:
+        """Fold one chunk into the counters and refresh the gauges.
+        ``metrics`` is the chunk's history dict (numpy arrays keyed
+        like SimResult fields); missing keys are tolerated so the SIR
+        engines ride the same tracker."""
+        rec = recorder()
+        if not rec.enabled:
+            return
+        import numpy as np
+
+        self.rounds += int(rounds)
+        self.wall_s += float(wall_s)
+        chunk_model = self.dense_bytes_round * rounds
+        self.model_bytes += chunk_model
+
+        # census-informed accounting: the live frontier width caps the
+        # model's per-round bytes for this chunk (the model's dense
+        # answer is its upper bound, so informed <= dense always)
+        fill = None
+        fs = metrics.get("frontier_size")
+        if fs is not None and len(fs):
+            fill = min(1.0, float(np.mean(np.asarray(
+                fs, dtype=np.float64))) / self.n_peers)
+        try:
+            informed = float(self._model_fn(fill)["total"]) * rounds
+        except Exception:  # noqa: BLE001 — model without fill support
+            informed = chunk_model
+        informed = min(informed, chunk_model)
+        self.census_bytes += informed
+
+        rec.counter_add("rounds_total", rounds)
+        rec.counter_add("wall_s_total", wall_s)
+        rec.counter_add("model_bytes_total", chunk_model)
+        rec.counter_add("census_bytes_total", informed)
+        dl = metrics.get("deliveries")
+        if dl is not None and len(dl):
+            rec.counter_add("deliveries_total",
+                            float(np.sum(np.asarray(dl,
+                                                    dtype=np.float64))))
+        cov = metrics.get("coverage")
+        if cov is not None and len(cov):
+            rec.gauge_set("coverage", float(np.asarray(cov)[-1]))
+        ni = metrics.get("new_infections")
+        if ni is not None and len(ni):
+            rec.counter_add("new_infections_total",
+                            float(np.sum(np.asarray(ni,
+                                                    dtype=np.float64))))
+        if fill is not None:
+            rec.gauge_set("frontier_fill", round(fill, 6))
+
+        # the two headline gauges, recomputed from cumulative totals
+        if self.wall_s > 0:
+            gbs = self.model_bytes / self.wall_s / 1e9
+            rec.gauge_set("achieved_gb_s", round(gbs, 4))
+            rec.gauge_set("roofline_frac",
+                          round(gbs / self.roof_gb_s, 6))
+        if self.model_bytes > 0:
+            rec.gauge_set("model_drift_frac", round(
+                1.0 - self.census_bytes / self.model_bytes, 6))
+
+        # model-attributed exchange span (docs/OBSERVABILITY.md): the
+        # chunk wall scaled by the exchange terms' share of bytes
+        try:
+            terms = self._model_fn(fill)
+        except Exception:  # noqa: BLE001
+            terms = {}
+        ex = float(terms.get("delta_gather", 0) or 0)
+        total = float(terms.get("total", 0) or 0)
+        if ex > 0 and total > 0:
+            rec.span_record(
+                "exchange", wall_s * ex / total, modeled=True,
+                bytes_round=int(ex),
+                ici_bytes=int(terms.get("ici_gather", 0) or 0),
+                dcn_bytes=int(terms.get("dcn_gather", 0) or 0))

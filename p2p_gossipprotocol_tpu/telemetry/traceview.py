@@ -1,0 +1,73 @@
+"""Profiler-trace summarizer: top ops by total device time.
+
+The library home of what ``benchmarks/trace_top.py`` has always done
+(that script now delegates here, keeping its CLI), so the serving
+plane's on-demand ``profile`` document can round-trip a bounded
+``jax.profiler`` capture through the same summarizer the offline
+post-mortems use — one accounting, two surfaces.
+
+``summarize`` keeps only the "XLA Ops" lanes when the trace has them
+(device traces nest module/step spans around the op spans — summing
+every lane would double-count device time and halve each kernel's
+share) and falls back to the everything-but-python filter for CPU
+rehearsal traces.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from collections import defaultdict
+
+
+def find_trace(path: str) -> str:
+    """``path`` itself when it is a file, else the newest
+    ``*.trace.json.gz`` under it (raises SystemExit when none)."""
+    if os.path.isfile(path):
+        return path
+    hits = sorted(glob.glob(os.path.join(path, "**", "*.trace.json.gz"),
+                            recursive=True), key=os.path.getmtime)
+    if not hits:
+        raise SystemExit(f"no *.trace.json.gz under {path!r}")
+    return hits[-1]
+
+
+def summarize(trace_file: str, top_n: int = 20) -> list[dict]:
+    """Top-``top_n`` ops by total device time: one dict per op with
+    name, call count, total ms, and share of the traced device time."""
+    with gzip.open(trace_file, "rt") as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", [])
+    dur_by_name: dict[str, float] = defaultdict(float)
+    calls: dict[str, int] = defaultdict(int)
+    pid_names = {e.get("pid"): e.get("args", {}).get("name", "")
+                 for e in events
+                 if e.get("ph") == "M" and e.get("name") == "process_name"}
+    tid_names = {(e.get("pid"), e.get("tid")):
+                 e.get("args", {}).get("name", "")
+                 for e in events
+                 if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    op_lanes = {k for k, v in tid_names.items() if "XLA Ops" in v}
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        if op_lanes:
+            if (e.get("pid"), e.get("tid")) not in op_lanes:
+                continue
+        else:
+            lane = pid_names.get(e.get("pid"), "")
+            if "python" in lane.lower():
+                continue
+        name = e.get("name", "?")
+        if name.startswith("$"):   # python source spans ($file.py:line)
+            continue
+        dur_by_name[name] += e["dur"]          # microseconds
+        calls[name] += 1
+    total = sum(dur_by_name.values()) or 1.0
+    return [{"op": k, "calls": calls[k],
+             "total_ms": round(v / 1e3, 3),
+             "share": round(v / total, 4)}
+            for k, v in sorted(dur_by_name.items(),
+                               key=lambda kv: -kv[1])[:top_n]]

@@ -1,3 +1,4 @@
-from p2p_gossipprotocol_tpu.utils.logging import NodeLogger
+from p2p_gossipprotocol_tpu.utils.logging import (NodeLogger, append_jsonl,
+                                                  append_line, read_jsonl)
 
-__all__ = ["NodeLogger"]
+__all__ = ["NodeLogger", "append_jsonl", "append_line", "read_jsonl"]

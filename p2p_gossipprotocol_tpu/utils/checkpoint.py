@@ -397,27 +397,47 @@ def run_chunked(sim, rounds: int, *, every: int, state=None, topo=None,
     Returns ``(result, state, topo, hist, wall, done)`` where ``result``
     is the rebuilt result object covering rounds [0, done), or None if
     no chunk ran AND no prior history was supplied.
+
+    Telemetry (docs/OBSERVABILITY.md): with the process recorder
+    enabled, each chunk runs inside a ``chunk`` span under one ``run``
+    span, and the chunk's already-materialized census feeds the live
+    roofline (telemetry.RooflineTracker — census vs traffic_model()
+    reconciliation).  All host-side, AFTER the chunk's device work
+    completes: the compiled program and its results are bit-for-bit
+    identical with telemetry on or off (tests/test_telemetry.py).
     """
     import dataclasses
     import inspect
 
     import numpy as np
 
+    from p2p_gossipprotocol_tpu import telemetry
+
+    rec = telemetry.recorder()
+    tracker = (telemetry.RooflineTracker.for_sim(sim)
+               if rec.enabled else None)
     takes_topo = "topo" in inspect.signature(sim.run).parameters
-    while done < rounds and not (should_stop() if should_stop else False):
-        step = min(every, rounds - done)
-        kw = {"topo": topo} if takes_topo else {}
-        r = sim.run(step, state=state, **kw)
-        result_cls = type(r)
-        state, topo = r.state, r.topo
-        part = {f.name: getattr(r, f.name) for f in dataclasses.fields(r)
-                if f.name not in ("state", "topo", "wall_s")}
-        hist = part if hist is None else \
-            {k: np.concatenate([hist[k], part[k]]) for k in part}
-        wall += float(r.wall_s)
-        done += step
-        if after_chunk is not None:
-            after_chunk(state, topo, hist, wall, done)
+    with rec.span("run", engine=type(sim).__name__, rounds=rounds,
+                  start_round=done):
+        while done < rounds \
+                and not (should_stop() if should_stop else False):
+            step = min(every, rounds - done)
+            kw = {"topo": topo} if takes_topo else {}
+            with rec.span("chunk", rounds=step, start_round=done):
+                r = sim.run(step, state=state, **kw)
+            result_cls = type(r)
+            state, topo = r.state, r.topo
+            part = {f.name: getattr(r, f.name)
+                    for f in dataclasses.fields(r)
+                    if f.name not in ("state", "topo", "wall_s")}
+            hist = part if hist is None else \
+                {k: np.concatenate([hist[k], part[k]]) for k in part}
+            wall += float(r.wall_s)
+            done += step
+            if tracker is not None:
+                tracker.update(step, float(r.wall_s), part)
+            if after_chunk is not None:
+                after_chunk(state, topo, hist, wall, done)
     if hist is None:
         return None, state, topo, hist, wall, done
     if result_cls is None:

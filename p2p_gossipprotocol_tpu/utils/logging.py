@@ -2,15 +2,75 @@
 seed.cpp:180-188): one file per node role+port, each line timestamped.
 
 Adds what the reference lacks (SURVEY §5 observability): an optional
-structured JSONL stream alongside the human-readable lines.
+structured JSONL stream alongside the human-readable lines — and the
+concurrency discipline the serving/supervision planes need: every line
+lands as ONE ``write()`` on an ``O_APPEND`` descriptor (POSIX makes
+that atomic with respect to the file offset), so concurrent writers —
+serve handler threads, supervisor + workers sharing a run dir — can
+never interleave partial lines.  The matching reader skips torn lines
+(a crash mid-write leaves at most one).  This is the SAME discipline
+``fleet/driver.append_rows`` established for results tables; the
+writer/reader pair lives here now and the driver delegates, so the two
+surfaces cannot drift.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from pathlib import Path
+
+
+def append_line(path: str | Path, text: str) -> None:
+    """Append ``text`` as one line: O_APPEND open + a single
+    ``write()`` — atomic w.r.t. the file offset under POSIX, so
+    interleaved writers cannot splice bytes inside each other's
+    lines."""
+    data = (text.rstrip("\n") + "\n").encode()
+    fd = os.open(str(path), os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                 0o644)
+    try:
+        os.write(fd, data)
+    finally:
+        os.close(fd)
+
+
+def append_jsonl(path: str | Path, rows: list) -> None:
+    """Concurrency-safe JSONL append: one ``write()`` per row on an
+    O_APPEND descriptor (one open per batch).  A row never contains a
+    newline (``json.dumps`` default), so one row is exactly one
+    line."""
+    fd = os.open(str(path), os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                 0o644)
+    try:
+        for r in rows:
+            os.write(fd, (json.dumps(r) + "\n").encode())
+    finally:
+        os.close(fd)
+
+
+def read_jsonl(path: str | Path) -> list:
+    """Read a JSONL file, skipping torn lines: a writer crashing
+    mid-``write()`` leaves at most one partial row (no trailing
+    newline, or truncated JSON); the reader drops any line that does
+    not parse instead of failing the whole table — the torn-line twin
+    of the checkpoint layer's torn-write discipline."""
+    rows: list = []
+    try:
+        with open(path, "rb") as fp:
+            data = fp.read()
+    except OSError:
+        return rows
+    for ln in data.split(b"\n"):
+        if not ln.strip():
+            continue
+        try:
+            rows.append(json.loads(ln))
+        except (ValueError, UnicodeDecodeError):
+            continue               # torn row (crash mid-write): skip
+    return rows
 
 
 class NodeLogger:
@@ -18,7 +78,13 @@ class NodeLogger:
 
     Filenames match peer.cpp:21 / seed.cpp:18 so tooling written against
     the reference's logs keeps working.
-    """
+
+    Each destination is opened ONCE (O_APPEND, lazily on first
+    ``log()``) and every line is a single ``write()`` — the old
+    open-per-call pattern paid a syscall tax per line and, worse,
+    buffered writes could interleave when serve/supervisor threads
+    shared a log.  ``close()`` releases the descriptors (idempotent;
+    also the context-manager exit)."""
 
     def __init__(self, role: str, port: int, directory: str | Path = ".",
                  jsonl: bool = False):
@@ -26,13 +92,50 @@ class NodeLogger:
         self.jsonl_path = (Path(directory) / f"{role}_{port}_events.jsonl"
                            if jsonl else None)
         self._lock = threading.Lock()
+        self._fd: int | None = None
+        self._jfd: int | None = None
+
+    def _fds(self) -> tuple[int, int | None]:
+        if self._fd is None:
+            self._fd = os.open(
+                str(self.path),
+                os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        if self._jfd is None and self.jsonl_path is not None:
+            self._jfd = os.open(
+                str(self.jsonl_path),
+                os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        return self._fd, self._jfd
 
     def log(self, message: str, **fields) -> None:
         stamp = time.ctime()
         with self._lock:
-            with open(self.path, "a") as f:
-                f.write(f"{stamp}: {message}\n")
-            if self.jsonl_path is not None:
-                with open(self.jsonl_path, "a") as f:
-                    f.write(json.dumps(
-                        {"t": time.time(), "msg": message, **fields}) + "\n")
+            fd, jfd = self._fds()
+            os.write(fd, f"{stamp}: {message}\n".encode())
+            if jfd is not None:
+                os.write(jfd, (json.dumps(
+                    {"t": time.time(), "msg": message, **fields})
+                    + "\n").encode())
+
+    def read_events(self) -> list:
+        """The structured stream back, torn lines skipped
+        (:func:`read_jsonl`)."""
+        if self.jsonl_path is None:
+            return []
+        return read_jsonl(self.jsonl_path)
+
+    def close(self) -> None:
+        with self._lock:
+            for attr in ("_fd", "_jfd"):
+                fd = getattr(self, attr)
+                if fd is not None:
+                    try:
+                        os.close(fd)
+                    except OSError:
+                        pass
+                    setattr(self, attr, None)
+
+    def __enter__(self) -> "NodeLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -113,7 +113,9 @@ def degradation_summary(res, target: float = 0.99,
 @contextlib.contextmanager
 def profile(log_dir: str | None):
     """``jax.profiler`` trace around the enclosed block; no-op when
-    ``log_dir`` is None (so callers can thread a CLI flag straight in)."""
+    ``log_dir`` is None (so callers can thread a CLI flag straight in).
+    The capture lands in the telemetry event ledger, so a flight-
+    recorder dump records that (and where) this run was profiled."""
     if log_dir is None:
         yield
         return
@@ -124,6 +126,10 @@ def profile(log_dir: str | None):
         yield
     finally:
         jax.profiler.stop_trace()
+        from p2p_gossipprotocol_tpu import telemetry
+
+        telemetry.event("profile_capture", trace=log_dir,
+                        source="cli --profile-dir")
 
 
 class RoundLogger:

@@ -100,12 +100,14 @@ def _socket_suite_timeout(request):
 
     mod = getattr(request.module, "__name__", "")
     guarded = "socket" in mod or "preemption" in mod \
-        or "supervisor" in mod or "serve" in mod
+        or "supervisor" in mod or "serve" in mod \
+        or "telemetry" in mod
     if not guarded or not hasattr(signal, "SIGALRM"):
         yield
         return
     budget = (SUPERVISOR_TEST_TIMEOUT_S
               if "supervisor" in mod or "serve" in mod
+              or "telemetry" in mod
               else SOCKET_TEST_TIMEOUT_S)
 
     def _fire(signum, frame):

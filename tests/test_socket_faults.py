@@ -220,6 +220,21 @@ def test_broadcast_rolls_back_only_exhausted_targets():
         node.connected_peers[dead_key] = dead_sock
         dying.close()       # the peer process dies: port gone
         dead_sock.close()   # and the established link with it
+        # re-bind the freed port WITHOUT listening: reconnects now
+        # refuse deterministically, and no concurrently-running test
+        # can claim the freed ephemeral port and accept the reconnect
+        # (observed under full-suite load — the retry then "delivered"
+        # to a stranger and sent_to kept the dead key).  The bind polls
+        # briefly: the just-closed endpoints linger in TIME_WAIT, which
+        # SO_REUSEADDR overrides once both sides have actually closed.
+        blocker = socket.socket()
+        blocker.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        for _ in range(200):
+            try:
+                blocker.bind(("127.0.0.1", dying.port))
+                break
+            except OSError:
+                time.sleep(0.01)
         msg = Message(content="x", timestamp="1", source_ip=node.ip,
                       source_port=node.port, msg_number=0)
         msg.hash = calculate_message_hash(msg)
@@ -230,6 +245,7 @@ def test_broadcast_rolls_back_only_exhausted_targets():
         assert dead_key not in tracker.sent_to
         assert _wait(lambda: any(d.get("content") == "x"
                                  for d in rx.docs))
+        blocker.close()
     finally:
         node.running = False
         rx.close()
