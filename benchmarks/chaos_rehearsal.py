@@ -166,9 +166,11 @@ def run_chaos(seed: int, kill: str, victim: str, deadline_s: float,
     plan_d = chaos_plan(seed, kill, victim)
     base = keep_dir or tempfile.mkdtemp(prefix="gossip_chaos_")
     os.makedirs(base, exist_ok=True)
+    from p2p_gossipprotocol_tpu.utils.logging import write_atomic
+
     cfg_path = os.path.join(base, "net.txt")
-    with open(cfg_path, "w") as fp:
-        fp.write(CONFIG_TEXT.format(rounds=ROUNDS, workers=N_WORKERS,
+    write_atomic(cfg_path,
+                 CONFIG_TEXT.format(rounds=ROUNDS, workers=N_WORKERS,
                                     devs=DEVS_PER_PROC,
                                     deadline=deadline_s))
     cfg = NetworkConfig(cfg_path)

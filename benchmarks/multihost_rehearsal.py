@@ -326,8 +326,11 @@ def driver(rounds: int, hier: bool = False) -> int:
     }
     out = OUT_HIER if hier else OUT
     os.makedirs(os.path.dirname(out), exist_ok=True)
-    with open(out, "w") as f:
-        json.dump(artifact, f, indent=1)
+    from p2p_gossipprotocol_tpu.utils.logging import write_atomic
+
+    # tmp+rename: a timeout-kill mid-dump must not tear the committed
+    # green artifact this file exists to preserve
+    write_atomic(out, json.dumps(artifact, indent=1))
     print(json.dumps(artifact))
     return 0 if ok else 1
 
@@ -347,25 +350,28 @@ def supervised_driver(rounds: int, hier: bool = False) -> int:
         supervise_from_config
 
     base = tempfile.mkdtemp(prefix="gossip_mh_supervised_")
+    from p2p_gossipprotocol_tpu.utils.logging import write_atomic
+
     cfg_path = os.path.join(base, "net.txt")
-    with open(cfg_path, "w") as fp:
-        fp.write("127.0.0.1:9001\nbackend=jax\nengine=aligned\n"
-                 f"n_peers={CONFIG['n_peers']}\n"
-                 f"n_messages={CONFIG['n_msgs']}\n"
-                 f"mode={CONFIG['mode']}\n"
-                 f"message_stagger={CONFIG['message_stagger']}\n"
-                 f"roll_groups={CONFIG['roll_groups']}\n"
-                 f"pull_window={int(CONFIG['pull_window'])}\n"
-                 f"fuse_update={int(CONFIG['fuse_update'])}\n"
-                 f"churn_rate={CONFIG['churn_rate']}\nprng_seed=3\n"
-                 f"rounds={rounds}\n"
-                 "supervise=1\n"
-                 f"supervise_workers={N_PROCS}\n"
-                 f"supervise_devs_per_proc={DEVS_PER_PROC}\n"
-                 "supervise_spmd=auto\n"
-                 + (f"hier_hosts={N_PROCS}\n"
-                    f"hier_devs={DEVS_PER_PROC}\n"
-                    "hier_mode=1\nfrontier_mode=1\n" if hier else ""))
+    write_atomic(
+        cfg_path,
+        "127.0.0.1:9001\nbackend=jax\nengine=aligned\n"
+        f"n_peers={CONFIG['n_peers']}\n"
+        f"n_messages={CONFIG['n_msgs']}\n"
+        f"mode={CONFIG['mode']}\n"
+        f"message_stagger={CONFIG['message_stagger']}\n"
+        f"roll_groups={CONFIG['roll_groups']}\n"
+        f"pull_window={int(CONFIG['pull_window'])}\n"
+        f"fuse_update={int(CONFIG['fuse_update'])}\n"
+        f"churn_rate={CONFIG['churn_rate']}\nprng_seed=3\n"
+        f"rounds={rounds}\n"
+        "supervise=1\n"
+        f"supervise_workers={N_PROCS}\n"
+        f"supervise_devs_per_proc={DEVS_PER_PROC}\n"
+        "supervise_spmd=auto\n"
+        + (f"hier_hosts={N_PROCS}\n"
+           f"hier_devs={DEVS_PER_PROC}\n"
+           "hier_mode=1\nfrontier_mode=1\n" if hier else ""))
     cfg = NetworkConfig(cfg_path)
     res = supervise_from_config(
         cfg, config_path=cfg_path, rounds=rounds,
@@ -381,8 +387,7 @@ def supervised_driver(rounds: int, hier: bool = False) -> int:
                 **res.summary()}
     out = OUT_HIER_SUPERVISED if hier else OUT_SUPERVISED
     os.makedirs(os.path.dirname(out), exist_ok=True)
-    with open(out, "w") as f:
-        json.dump(artifact, f, indent=1)
+    write_atomic(out, json.dumps(artifact, indent=1))
     print(json.dumps(artifact))
     if res.skipped:
         return 3

@@ -30,6 +30,9 @@ import os
 import sys
 
 HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:           # write_atomic lives in the package
+    sys.path.insert(0, REPO)
 R3_NORTH_STAR_S = 0.0716        # BENCH_r03: 1M to 99% on the chip
 
 
@@ -238,8 +241,9 @@ def main() -> int:
     text = "\n".join(report)
     print(text)
     if len(sys.argv) > 1:
-        with open(sys.argv[1], "w") as f:
-            f.write(text + "\n")
+        from p2p_gossipprotocol_tpu.utils.logging import write_atomic
+
+        write_atomic(sys.argv[1], text + "\n")
     return 0
 
 

@@ -190,10 +190,26 @@ step_tmo() {
   esac
 }
 
+# Pre-window lint gate (gossip-lint, docs/STATIC_ANALYSIS.md): a chip
+# window must never burn on a tree a static check would have rejected —
+# a contract break (unrecorded clamp, torn-write site, signature drift)
+# invalidates the rows a step would record.  Runs on CPU in ~a second;
+# a red lint stands the window down for THIS pass only (it re-checks
+# every pass, so a fix picked up by the working tree resumes the run).
+lint_ok() {
+  JAX_PLATFORMS=cpu timeout -k 10 120 \
+    python -m p2p_gossipprotocol_tpu.analysis >>"$LOG" 2>&1
+}
+
 say "watchdog v2 start (pid $$)"
 while true; do
   if probe; then
-    say "tunnel UP — running unsettled steps"
+    if ! lint_ok; then
+      say "gossip-lint FAILED — not burning this window on a tree that flunks its own contracts (see $LOG); retrying next pass"
+      sleep 90
+      continue
+    fi
+    say "tunnel UP — lint clean, running unsettled steps"
     maybe_refresh_bench
     for name in $STEP_NAMES; do
       settled "$name" && continue

@@ -1,0 +1,47 @@
+"""gossip-lint: the repo's contract checker (docs/STATIC_ANALYSIS.md).
+
+Ten PRs grew ~15 cross-file contracts that existed only as prose in
+docs/ and reviewer memory — clamp events flow through two chokepoints,
+perf knobs stay out of checkpoint fingerprints but ride the fleet
+packer's signature, telemetry never imports jax, scheduler state is
+touched under ``self._lock``, artifacts land tmp+rename or O_APPEND.
+This package turns each of them into an AST rule (stdlib ``ast`` only,
+no new dependencies) so the round-program refactor and the serving
+scale-out can churn every engine without silently breaking the
+discipline the parity suite can't see.
+
+Surfaces:
+
+* ``python -m p2p_gossipprotocol_tpu.analysis`` — the CLI; exits
+  non-zero on any finding not covered by the committed baseline
+  (``analysis/baseline.txt``) and on stale baseline entries;
+* ``tests/test_analysis.py`` — tier-1 enforcement: the whole suite
+  runs over the package inside the existing pytest command;
+* ``make lint`` / the ``tpu_watchdog.sh`` pre-window step — the same
+  CLI, so a chip window is never burned on a run a static check would
+  have rejected.
+
+Adding a rule: write ``check(tree) -> list[Finding]``, decorate with
+:func:`rule`, import the module from :mod:`analysis.rules` — the
+walkthrough lives in docs/STATIC_ANALYSIS.md.
+"""
+
+from p2p_gossipprotocol_tpu.analysis.core import (Finding, Tree, load_tree,
+                                                  rule, run_rules, RULES)
+from p2p_gossipprotocol_tpu.analysis.baseline import (apply_baseline,
+                                                      load_baseline)
+from p2p_gossipprotocol_tpu.analysis import rules  # noqa: F401 — registry
+
+__all__ = ["Finding", "Tree", "load_tree", "rule", "run_rules", "RULES",
+           "apply_baseline", "load_baseline", "run_analysis"]
+
+
+def run_analysis(root=None, baseline_path=None, rule_ids=None):
+    """Load the tree at ``root`` (default: this repo), run every
+    registered rule (or just ``rule_ids``), apply the baseline, and
+    return ``(findings, stale_entries)`` — both empty on a clean tree.
+    The tier-1 test and the CLI share this entry point."""
+    tree = load_tree(root)
+    findings = run_rules(tree, rule_ids=rule_ids)
+    entries = load_baseline(baseline_path, root=tree.root)
+    return apply_baseline(findings, entries)

@@ -1,0 +1,98 @@
+"""``python -m p2p_gossipprotocol_tpu.analysis`` — the gossip-lint CLI.
+
+Exit 0: every finding is covered by the baseline and no baseline entry
+is stale.  Exit 1: findings (printed one per line as
+``file:line: [rule] message``).  Exit 2: usage error.
+
+    python -m p2p_gossipprotocol_tpu.analysis              # whole repo
+    python -m p2p_gossipprotocol_tpu.analysis --list-rules
+    python -m p2p_gossipprotocol_tpu.analysis --rules lock-discipline
+    python -m p2p_gossipprotocol_tpu.analysis --no-baseline   # raw view
+    python -m p2p_gossipprotocol_tpu.analysis --json
+
+``make lint`` and the ``tpu_watchdog.sh`` pre-window step both invoke
+this; ``tests/test_analysis.py`` runs the same entry inside tier-1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from p2p_gossipprotocol_tpu.analysis import (RULES, apply_baseline,
+                                             load_baseline, load_tree,
+                                             run_rules)
+from p2p_gossipprotocol_tpu.analysis.baseline import DEFAULT_BASELINE
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m p2p_gossipprotocol_tpu.analysis",
+        description="gossip-lint: the repo's AST contract checker "
+                    "(docs/STATIC_ANALYSIS.md)")
+    ap.add_argument("--root", default=None,
+                    help="repo root to analyze (default: the repo "
+                         "this package was loaded from)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"suppression file (default: "
+                         f"{DEFAULT_BASELINE.name} next to the "
+                         "analysis package)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline — show every raw finding")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run (default: "
+                         "all)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, (_fn, contract) in RULES.items():
+            print(f"{rid:24s} {contract}")
+        return 0
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rule_ids - set(RULES)
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))} "
+                  f"(--list-rules)", file=sys.stderr)
+            return 2
+
+    tree = load_tree(args.root)
+    findings = run_rules(tree, rule_ids=rule_ids)
+    if args.no_baseline:
+        stale = []
+    else:
+        entries = load_baseline(args.baseline, root=tree.root)
+        if rule_ids is not None:
+            entries = [e for e in entries if e.rule in rule_ids]
+        findings, stale = apply_baseline(findings, entries)
+
+    if args.as_json:
+        print(json.dumps([f.__dict__ for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        if findings:
+            n_stale = sum(1 for f in findings
+                          if f.rule == "stale-suppression")
+            n_real = len(findings) - n_stale
+            print(f"\ngossip-lint: {n_real} finding(s), "
+                  f"{n_stale} stale suppression(s) "
+                  f"across {len(tree.sources)} file(s)",
+                  file=sys.stderr)
+        else:
+            print(f"gossip-lint: clean "
+                  f"({len(tree.sources)} file(s), "
+                  f"{len(rule_ids) if rule_ids else len(RULES)} "
+                  "rule(s))", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,108 @@
+"""The committed suppression file and its round-trip semantics.
+
+``analysis/baseline.txt`` is the ONLY way a finding may stay in the
+tree: one line per intentional exception, pipe-separated —
+
+    rule-id | file | message-substring | justification
+
+An entry suppresses every current finding whose rule and file match
+exactly and whose message contains the substring.  Two failure modes
+are themselves findings, so the baseline can never rot silently:
+
+* an entry with fewer than four fields or an empty justification is a
+  ``baseline-format`` finding (an unexplained suppression is a
+  violation of the violation);
+* an entry that matches NO current finding is a ``stale-suppression``
+  finding — the code it excused was fixed or moved, so the entry must
+  be deleted (the add → suppress → stale round-trip
+  tests/test_analysis.py pins).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from pathlib import Path
+
+from p2p_gossipprotocol_tpu.analysis.core import Finding
+
+#: the committed baseline, next to this module
+DEFAULT_BASELINE = Path(__file__).with_name("baseline.txt")
+
+
+@dataclass
+class BaselineEntry:
+    rule: str
+    file: str
+    match: str
+    why: str
+    line: int           # line in baseline.txt (for stale reports)
+    src: str            # baseline file path (repo-relative-ish)
+    hits: int = 0
+
+
+def load_baseline(path: str | Path | None = None,
+                  root: Path | None = None) -> list[BaselineEntry]:
+    """Parse the baseline file (default: the committed one).  Format
+    errors come back as entries with ``rule == 'baseline-format'`` so
+    :func:`apply_baseline` can surface them as findings."""
+    path = Path(path) if path is not None else DEFAULT_BASELINE
+    entries: list[BaselineEntry] = []
+    if not path.exists():
+        return entries
+    try:
+        rel = path.resolve().relative_to(root.resolve()).as_posix() \
+            if root else path.name
+    except ValueError:
+        rel = path.name
+    for i, raw in enumerate(path.read_text().splitlines(), start=1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = [p.strip() for p in line.split("|")]
+        if len(parts) < 4 or not all(parts[:3]) or not parts[3]:
+            entries.append(BaselineEntry(
+                rule="baseline-format", file=rel, match=line,
+                why="", line=i, src=rel))
+            continue
+        entries.append(BaselineEntry(
+            rule=parts[0], file=parts[1], match=parts[2],
+            why="|".join(parts[3:]), line=i, src=rel))
+    return entries
+
+
+def apply_baseline(findings: list[Finding],
+                   entries: list[BaselineEntry]
+                   ) -> tuple[list[Finding], list[BaselineEntry]]:
+    """Split ``findings`` against the baseline: returns
+    ``(unsuppressed_findings, stale_entries)``.  Format errors in the
+    baseline join the findings; an entry that matched nothing is
+    stale."""
+    out: list[Finding] = []
+    good = []
+    for e in entries:
+        if e.rule == "baseline-format":
+            out.append(Finding(
+                "baseline-format", e.src, e.line,
+                "baseline entry needs 'rule | file | match | "
+                f"justification' with all fields non-empty: {e.match!r}"))
+        else:
+            good.append(e)
+    for f in findings:
+        hit = None
+        for e in good:
+            if e.rule == f.rule and e.file == f.file \
+                    and e.match in f.message:
+                hit = e
+                break
+        if hit is not None:
+            hit.hits += 1
+        else:
+            out.append(f)
+    stale = [e for e in good if e.hits == 0]
+    for e in stale:
+        out.append(Finding(
+            "stale-suppression", e.src, e.line,
+            f"baseline entry matches no current finding (fixed or "
+            f"moved — delete it): {e.rule} | {e.file} | {e.match}"))
+    return (sorted(out, key=lambda f: (f.file, f.line, f.rule,
+                                       f.message)), stale)

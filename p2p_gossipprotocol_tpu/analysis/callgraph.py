@@ -1,0 +1,207 @@
+"""Traced-entry discovery + call-graph walk for the tracing-safety rule.
+
+The engines hand functions to ``jax.jit`` / ``pl.pallas_call`` /
+``shard_map_compat`` / ``lax.scan``-family wrappers; everything those
+functions call (lexically resolvable defs, ``self.`` methods, imports
+from inside the package) executes under trace, where a host escape —
+``time.time()``, ``random.*``, ``np.random``, ``.item()``, ``open()``
+— either crashes at trace time or bakes one host value into the
+compiled program forever.  This module finds the traced set; the rule
+module scans it for escapes.
+
+Best-effort static resolution, deliberately: bare-name and ``self.``
+calls resolve lexically within a module, ``from pkg.mod import f``
+crosses modules inside the package.  What it cannot see (dynamic
+dispatch, functools tricks) it leaves untraced — a rule must be quiet
+enough to live in tier-1.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from p2p_gossipprotocol_tpu.analysis.contracts import TRACE_WRAPPERS
+from p2p_gossipprotocol_tpu.analysis.core import Source, Tree, dotted
+
+_FUNC = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+@dataclass
+class TracedFn:
+    source: Source
+    node: ast.AST
+    qualname: str
+    #: the wrapper / caller that put this function under trace
+    via: str
+    depth: int = 0
+
+
+@dataclass
+class _ModIndex:
+    source: Source
+    parents: dict = field(default_factory=dict)      # id(node) -> parent
+    imports: dict = field(default_factory=dict)      # local -> target
+
+    def parent(self, node):
+        return self.parents.get(id(node))
+
+    def scope_chain(self, node):
+        """Enclosing FunctionDef/ClassDef chain, innermost first."""
+        out = []
+        cur = self.parent(node)
+        while cur is not None:
+            if isinstance(cur, _FUNC + (ast.ClassDef,)):
+                out.append(cur)
+            cur = self.parent(cur)
+        return out
+
+    def qualname(self, node) -> str:
+        names = [getattr(node, "name", "<anon>")]
+        for s in self.scope_chain(node):
+            names.append(s.name)
+        return ".".join(reversed(names))
+
+
+def _index_module(src: Source) -> _ModIndex:
+    idx = _ModIndex(source=src)
+    for node in ast.walk(src.tree):
+        for child in ast.iter_child_nodes(node):
+            idx.parents[id(child)] = node
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                idx.imports[a.asname or a.name] = (node.module, a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                idx.imports[a.asname or a.name.split(".")[0]] = \
+                    (a.name, None)
+    return idx
+
+
+def _defs_in(scope) -> dict:
+    body = scope.body if hasattr(scope, "body") else []
+    return {n.name: n for n in body if isinstance(n, _FUNC)}
+
+
+def _resolve_lexical(idx: _ModIndex, at_node, name: str):
+    """A bare-name def visible from ``at_node``: enclosing function
+    bodies innermost-out, then module top level."""
+    for scope in idx.scope_chain(at_node):
+        if isinstance(scope, _FUNC) and name in _defs_in(scope):
+            return _defs_in(scope)[name]
+    return _defs_in(idx.source.tree).get(name)
+
+
+def _resolve_method(idx: _ModIndex, at_node, name: str):
+    """``self.<name>`` -> the method on the enclosing class."""
+    for scope in idx.scope_chain(at_node):
+        if isinstance(scope, ast.ClassDef):
+            return _defs_in(scope).get(name)
+    return None
+
+
+def _module_rel(module: str) -> str:
+    return module.replace(".", "/") + ".py"
+
+
+def _wrapper_name(call_func) -> str | None:
+    d = dotted(call_func)
+    if d in TRACE_WRAPPERS:
+        return d
+    return None
+
+
+def _is_partial_of_wrapper(call: ast.Call) -> str | None:
+    """``partial(jax.jit, ...)`` / ``functools.partial(jit, ...)``."""
+    d = dotted(call.func)
+    if d in ("partial", "functools.partial") and call.args:
+        return _wrapper_name(call.args[0])
+    return None
+
+
+def traced_functions(tree: Tree) -> list[TracedFn]:
+    """Every function the walk can prove runs under trace, with the
+    wrapper (or traced caller) that got it there."""
+    indices = {s.rel: _index_module(s) for s in tree.package_sources()}
+    top_defs = {rel: _defs_in(idx.source.tree)
+                for rel, idx in indices.items()}
+
+    roots: list[TracedFn] = []
+    seen: set[tuple[str, int]] = set()
+
+    def add(src: Source, node, via: str, depth: int):
+        key = (src.rel, id(node))
+        if node is None or key in seen:
+            return
+        seen.add(key)
+        roots.append(TracedFn(source=src, node=node,
+                              qualname=indices[src.rel].qualname(node),
+                              via=via, depth=depth))
+
+    # -- entry points: function-valued args of trace wrappers ---------
+    for rel, idx in indices.items():
+        src = idx.source
+        for node in ast.walk(src.tree):
+            if isinstance(node, _FUNC):
+                for dec in node.decorator_list:
+                    via = None
+                    if _wrapper_name(dec):
+                        via = dotted(dec)
+                    elif isinstance(dec, ast.Call) and (
+                            _wrapper_name(dec.func)
+                            or _is_partial_of_wrapper(dec)):
+                        via = dotted(dec.func)
+                    if via:
+                        add(src, node, f"@{via}", 0)
+            if not isinstance(node, ast.Call):
+                continue
+            via = _wrapper_name(node.func)
+            if via is None:
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    fn = _resolve_lexical(idx, node, arg.id)
+                    if fn is not None:
+                        add(src, fn, via, 0)
+
+    # -- BFS the call graph under trace -------------------------------
+    i = 0
+    while i < len(roots):
+        t = roots[i]
+        i += 1
+        idx = indices[t.source.rel]
+        for call in ast.walk(t.node):
+            if not isinstance(call, ast.Call):
+                continue
+            f = call.func
+            if isinstance(f, ast.Name):
+                target = _resolve_lexical(idx, call, f.id)
+                if target is not None:
+                    add(t.source, target, t.qualname, t.depth + 1)
+                    continue
+                imp = idx.imports.get(f.id)
+                if imp and imp[1]:
+                    rel2 = _module_rel(imp[0])
+                    if rel2 in top_defs and imp[1] in top_defs[rel2]:
+                        add(indices[rel2].source,
+                            top_defs[rel2][imp[1]], t.qualname,
+                            t.depth + 1)
+            elif isinstance(f, ast.Attribute):
+                if isinstance(f.value, ast.Name) \
+                        and f.value.id in ("self", "cls"):
+                    target = _resolve_method(idx, call, f.attr)
+                    if target is not None:
+                        add(t.source, target, t.qualname, t.depth + 1)
+                    continue
+                d = dotted(f)
+                if d:
+                    base = d.rsplit(".", 1)[0]
+                    imp = idx.imports.get(base)
+                    if imp and imp[1] is None:        # import pkg.mod
+                        rel2 = _module_rel(imp[0])
+                        if rel2 in top_defs and f.attr in top_defs[rel2]:
+                            add(indices[rel2].source,
+                                top_defs[rel2][f.attr], t.qualname,
+                                t.depth + 1)
+    return roots

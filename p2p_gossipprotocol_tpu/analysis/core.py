@@ -1,0 +1,191 @@
+"""Rule registry, finding objects, and the parsed-source tree model.
+
+A :class:`Tree` is every analyzed source file parsed once (``ast`` +
+raw text), plus the repo root so rules can read non-Python contract
+surfaces (``network.txt``).  Rules are plain functions
+``check(tree) -> list[Finding]`` registered by the :func:`rule`
+decorator; the registry is ordered so reports are deterministic.
+
+Rules locate their target files by DEFINED SYMBOL, not by hard-coded
+path (:meth:`Tree.defining`) — which is what lets the fixture suites in
+``tests/fixtures/analysis/`` exercise every rule on a five-line
+violating snippet laid out like a miniature repo.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+
+#: directories never analyzed (caches, fixtures are loaded explicitly
+#: by the fixture tests, the native build tree is C++)
+_SKIP_DIRS = {"__pycache__", ".git", "native", "peer_network",
+              "fixtures", ".claude"}
+
+#: analysis scope relative to the repo root: the package itself, the
+#: benchmark drivers (write-discipline territory), and bench.py
+_SCOPE = ("p2p_gossipprotocol_tpu", "benchmarks", "bench.py")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at ``file:line`` — the unit the baseline
+    suppresses and the CLI prints."""
+
+    rule: str
+    file: str           # repo-relative posix path
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class Source:
+    """One parsed file: path, text, AST."""
+
+    rel: str
+    path: Path
+    text: str
+    tree: ast.Module
+
+    @property
+    def lines(self) -> list[str]:
+        return self.text.splitlines()
+
+
+@dataclass
+class Tree:
+    """The analyzed repo: parsed sources + the root for side files."""
+
+    root: Path
+    sources: list[Source] = field(default_factory=list)
+
+    def get(self, rel: str) -> Source | None:
+        for s in self.sources:
+            if s.rel == rel:
+                return s
+        return None
+
+    def package_sources(self) -> list[Source]:
+        """Sources inside the python package (engine/runtime code) —
+        the scope of the semantic rules; benchmarks/bench.py join only
+        the write-discipline sweep."""
+        return [s for s in self.sources
+                if s.rel.split("/")[0] not in ("benchmarks",)
+                and s.rel != "bench.py"]
+
+    def defining(self, symbol: str, kind=(ast.FunctionDef, ast.ClassDef)
+                 ) -> list[tuple[Source, ast.AST]]:
+        """Every (source, node) whose module defines top-level
+        ``symbol`` — how rules find their contract files without
+        hard-coding paths (fixtures mimic the layout)."""
+        out = []
+        for s in self.sources:
+            for node in s.tree.body:
+                if isinstance(node, kind) and \
+                        getattr(node, "name", None) == symbol:
+                    out.append((s, node))
+        return out
+
+
+def _iter_py(root: Path):
+    for entry in _SCOPE:
+        p = root / entry
+        if p.is_file():
+            yield p
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                # skip-judgment on ROOT-relative parts only: a fixture
+                # tree may itself live under a skipped-name directory
+                # (tests/fixtures/...) and must still load when it IS
+                # the root
+                rel_parts = f.relative_to(root).parts
+                if not any(part in _SKIP_DIRS for part in rel_parts):
+                    yield f
+
+
+def load_tree(root: str | Path | None = None) -> Tree:
+    """Parse every in-scope source under ``root`` (default: the repo
+    this package was loaded from).  Files that fail to parse become a
+    ``parse-error`` finding at check time rather than an exception —
+    the linter must be able to report on a broken tree."""
+    if root is None:
+        root = Path(__file__).resolve().parents[2]
+    root = Path(root).resolve()
+    tree = Tree(root=root)
+    for f in _iter_py(root):
+        rel = f.relative_to(root).as_posix()
+        try:
+            text = f.read_text()
+            parsed = ast.parse(text, filename=rel)
+        except (OSError, SyntaxError) as e:
+            # carried as a pseudo-source; run_rules reports it
+            parsed = ast.Module(body=[], type_ignores=[])
+            tree.sources.append(Source(rel=rel, path=f,
+                                       text=f"# PARSE ERROR: {e}",
+                                       tree=parsed))
+            continue
+        tree.sources.append(Source(rel=rel, path=f, text=text,
+                                   tree=parsed))
+    return tree
+
+
+#: ordered rule registry: id -> (check_fn, one-line contract)
+RULES: dict[str, tuple] = {}
+
+
+def rule(rule_id: str, contract: str):
+    """Register ``check(tree) -> list[Finding]`` under ``rule_id``."""
+    def deco(fn):
+        RULES[rule_id] = (fn, contract)
+        fn.rule_id = rule_id
+        return fn
+    return deco
+
+
+def run_rules(tree: Tree, rule_ids=None) -> list[Finding]:
+    """Run the registered rules over ``tree``; findings are sorted by
+    file, line, rule so output is diff-stable."""
+    findings: list[Finding] = []
+    for s in tree.sources:
+        if s.text.startswith("# PARSE ERROR:"):
+            findings.append(Finding("parse-error", s.rel, 1,
+                                    s.text[2:].strip()))
+    for rid, (fn, _doc) in RULES.items():
+        if rule_ids is not None and rid not in rule_ids:
+            continue
+        findings.extend(fn(tree))
+    return sorted(findings, key=lambda f: (f.file, f.line, f.rule,
+                                           f.message))
+
+
+# ---------------------------------------------------------------------
+# Shared AST helpers the rule modules lean on.
+
+def dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def self_attr(node: ast.AST) -> str | None:
+    """``X`` when ``node`` is exactly ``self.X``, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def walk_calls(node: ast.AST):
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            yield n

@@ -1,0 +1,10 @@
+"""Rule modules — importing one registers its checks (core.rule).
+
+One module per contract family; the catalog with each rule's origin
+PR/doc lives in docs/STATIC_ANALYSIS.md.
+"""
+
+from p2p_gossipprotocol_tpu.analysis.rules import (clamps,  # noqa: F401
+                                                   configsurface,
+                                                   fingerprint, imports,
+                                                   locks, tracing, writes)

@@ -1,0 +1,153 @@
+"""Rule ``clamp-chokepoint``: degradations are recorded, and recorded
+through the two chokepoints.
+
+PR 10 unified every "recorded clamp" into one typed ledger with exactly
+two emission chokepoints — ``engines.build_simulator`` (wrapping every
+engine build) and ``serve/scheduler.resolve_request`` (the admission
+path that bypasses it).  Sites themselves just append a string to the
+``clamps`` list their caller threads through.  Two mechanical checks:
+
+* a call to ``record_clamps`` (or a raw ``event("clamp", ...)``)
+  anywhere except the chokepoints (and the recorder's own definition)
+  re-scatters the ledger — flagged;
+* a degradation branch — an ``if`` whose body assigns a known knob
+  (``*_mode``, ``block_perm``, ``pull_window``, ...) to a constant —
+  that contains neither a ``clamps.append(...)`` nor a ledger call is a
+  SILENT weakening of a configured scenario — flagged (branches that
+  are genuinely not degradations, e.g. a default-on key falling back
+  where the feature cannot exist, are baseline entries with the
+  justification spelled out).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from p2p_gossipprotocol_tpu.analysis.contracts import (CLAMP_CHOKEPOINTS,
+                                                       DEGRADE_KNOBS)
+from p2p_gossipprotocol_tpu.analysis.core import (Finding, dotted, rule,
+                                                  walk_calls)
+
+_FUNC = (ast.FunctionDef, ast.AsyncFunctionDef)
+_CHOKEPOINT_FUNCS = {fn for _scope, fn in CLAMP_CHOKEPOINTS}
+
+
+def _enclosing_funcs(src) -> dict[int, str]:
+    """id(node) -> name of the nearest enclosing function."""
+    out = {}
+
+    def visit(node, fname):
+        if isinstance(node, _FUNC):
+            fname = node.name
+        out[id(node)] = fname
+        for child in ast.iter_child_nodes(node):
+            visit(child, fname)
+
+    visit(src.tree, "<module>")
+    return out
+
+
+def _is_clamp_record(call: ast.Call) -> bool:
+    d = dotted(call.func) or ""
+    if d.split(".")[-1] == "record_clamps":
+        return True
+    if d.split(".")[-1] == "event" and call.args:
+        a0 = call.args[0]
+        return isinstance(a0, ast.Constant) and a0.value == "clamp"
+    return False
+
+
+def _is_clamp_append(call: ast.Call) -> bool:
+    """``clamps.append(...)`` — the site-level recording idiom (any
+    name containing 'clamp')."""
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr == "append"
+            and "clamp" in (dotted(f.value) or "").lower())
+
+
+def _const_knob_assigns(stmts) -> list[tuple[str, ast.AST]]:
+    """(knob, node) for assignments of a constant to a degrade knob
+    directly in this branch (nested ``if``s are their own branches)."""
+    out = []
+    for stmt in stmts:
+        if isinstance(stmt, ast.If):
+            continue              # judged as its own branch pair
+        for node in _walk_pruned(stmt):
+            if not isinstance(node, ast.Assign):
+                continue
+            v = node.value
+            if isinstance(v, ast.UnaryOp):
+                v = v.operand
+            if not isinstance(v, ast.Constant):
+                continue
+            for tgt in node.targets:
+                name = None
+                if isinstance(tgt, ast.Name):
+                    name = tgt.id
+                elif isinstance(tgt, ast.Attribute):
+                    name = tgt.attr
+                if name in DEGRADE_KNOBS:
+                    out.append((name, node))
+    return out
+
+
+def _walk_pruned(node):
+    """Subtree walk that stops at nested If statements (each branch is
+    judged on its own recording)."""
+    yield node
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, ast.If):
+            continue
+        yield from _walk_pruned(child)
+
+
+def _branch_records(stmts) -> bool:
+    """A recording ANYWHERE in the branch counts (including under a
+    nested guard like ``if clamps is not None:``) — asymmetric with
+    :func:`_const_knob_assigns`, which prunes nested ``if``s so each
+    degradation branch is judged on its own."""
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) and (
+                    _is_clamp_append(node) or _is_clamp_record(node)):
+                return True
+    return False
+
+
+@rule("clamp-chokepoint",
+      "degradation branches record a clamp; the typed ledger is only "
+      "emitted from build_simulator / resolve_request")
+def check(tree):
+    findings = []
+    for src in tree.package_sources():
+        enclosing = _enclosing_funcs(src)
+        in_telemetry = "/telemetry/" in f"/{src.rel}"
+        for call in walk_calls(src.tree):
+            if not _is_clamp_record(call):
+                continue
+            fname = enclosing.get(id(call), "<module>")
+            if fname in _CHOKEPOINT_FUNCS or in_telemetry:
+                continue
+            findings.append(Finding(
+                "clamp-chokepoint", src.rel, call.lineno,
+                f"clamp ledger emitted from {fname}() — clamp events "
+                "flow through engines.build_simulator or "
+                "serve/scheduler.resolve_request only (append to the "
+                "site's `clamps` list instead)"))
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.If):
+                continue
+            for branch in (node.body, node.orelse):
+                assigns = _const_knob_assigns(branch)
+                if not assigns:
+                    continue
+                if _branch_records(branch):
+                    continue
+                for knob, a in assigns:
+                    findings.append(Finding(
+                        "clamp-chokepoint", src.rel, a.lineno,
+                        f"conditional degradation of {knob!r} without "
+                        "a recorded clamp — a branch that weakens a "
+                        "configured knob must clamps.append(...) so "
+                        "the chokepoint ledger sees it"))
+    return findings

@@ -1,0 +1,108 @@
+"""Rule ``config-drift``: config.py vs network.txt vs consumers, 3-way.
+
+The reference's signature bug is parsing keys then ignoring them
+(config.cpp:93-96 vs peer.cpp:330+); this repo's counter-contract
+(config.py module docstring) is that every key is validated, documented
+in ``network.txt``, and consumed by some engine/plane.  Three drift
+directions, each its own finding:
+
+* **validated, undocumented** — a key in config.py's maps whose name
+  never appears in network.txt: invisible to deployments;
+* **documented, unvalidated** — a ``key=`` token in network.txt's
+  comments that config.py does not parse: a deployment sets it and the
+  lenient parser silently drops it (the reference's exact bug);
+* **validated, unconsumed** — a parsed attr no module ever reads:
+  parsed-then-ignored.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from p2p_gossipprotocol_tpu.analysis.core import Finding, rule
+from p2p_gossipprotocol_tpu.analysis.rules.fingerprint import \
+    _config_attr_map
+
+#: ``tok=`` tokens in network.txt that are documentation of OTHER
+#: surfaces, not config keys: the --fault-plan compact spec's field
+#: names, exit codes, and prose fragments
+_DOC_TOKEN_IGNORE = {
+    "drop", "delay", "duplicate", "partition", "crash", "recover",
+    "byzantine", "groups", "seed", "rc", "key", "value", "spmd",
+    "deadline_s", "grace_s", "max_failures",
+}
+
+_TOKEN_RE = re.compile(r"(?<![\w.\-])([a-z][a-z0-9_]{2,})=")
+
+
+def _documented_tokens(text: str) -> dict[str, int]:
+    """``key=`` tokens in comment lines -> first line number."""
+    out: dict[str, int] = {}
+    for i, line in enumerate(text.splitlines(), start=1):
+        if not line.lstrip().startswith("#"):
+            continue
+        for tok in _TOKEN_RE.findall(line):
+            out.setdefault(tok, i)
+    return out
+
+
+def _mentioned(text: str, key: str) -> bool:
+    return re.search(rf"(?<![\w\-]){re.escape(key)}(?![\w\-])",
+                     text) is not None
+
+
+def _consumed_attrs(tree, cfg_rel: str) -> set[str]:
+    """Attribute names read anywhere outside config.py — via
+    ``<obj>.<attr>`` or a literal ``"<attr>"`` string (the
+    getattr-loop idiom)."""
+    out: set[str] = set()
+    for src in tree.sources:
+        if src.rel == cfg_rel:
+            continue
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Attribute):
+                out.add(node.attr)
+            elif isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    node.value.isidentifier():
+                out.add(node.value)
+    return out
+
+
+@rule("config-drift",
+      "keys validated in config.py == keys documented in network.txt "
+      "== keys consumed somewhere (three-way)")
+def check(tree):
+    cfg_src, keymap = _config_attr_map(tree)
+    if cfg_src is None:
+        return []
+    net = tree.root / "network.txt"
+    findings = []
+    net_text = net.read_text() if net.exists() else None
+    if net_text is not None:
+        for key in sorted(keymap):
+            if not _mentioned(net_text, key):
+                findings.append(Finding(
+                    "config-drift", cfg_src.rel, 1,
+                    f"config key {key!r} is validated by config.py "
+                    "but never mentioned in network.txt — document "
+                    "it (the deployment surface is the config file)"))
+        for tok, line in sorted(_documented_tokens(net_text).items()):
+            if tok in keymap or tok in _DOC_TOKEN_IGNORE:
+                continue
+            findings.append(Finding(
+                "config-drift", "network.txt", line,
+                f"network.txt documents {tok!r}= but config.py does "
+                "not parse it — the lenient parser would silently "
+                "drop a deployment's setting (the reference's "
+                "parse-then-ignore bug)"))
+    consumed = _consumed_attrs(tree, cfg_src.rel)
+    for key, attr in sorted(keymap.items()):
+        if attr not in consumed:
+            findings.append(Finding(
+                "config-drift", cfg_src.rel, 1,
+                f"config key {key!r} (attr {attr!r}) is parsed and "
+                "validated but no module outside config.py reads it "
+                "— parsed-then-ignored"))
+    return findings

@@ -1,0 +1,167 @@
+"""Rules ``fingerprint-exclusion`` and ``packer-signature``.
+
+**fingerprint-exclusion** (PR 3's config fingerprint + the exclusion
+decisions of PRs 5/6/7/8/10): the set of config keys EXCLUDED from
+``engines.config_keys`` must exactly match the documented
+perf/placement/plane knob set (``contracts.FINGERPRINT_EXEMPT``), and
+every key ``config.py`` validates must be classified one way or the
+other — a new key that is neither fingerprinted nor classified is the
+drift this rule exists to catch before a checkpoint silently changes
+identity (or silently ignores a trajectory key).
+
+**packer-signature** (PR 4's one-program-per-bucket discipline): every
+resolved static ``AlignedSimulator`` bakes into its compiled round
+program (the underscore attributes its resolution paths assign) must
+appear in ``fleet/packer.bucket_signature`` or be listed in
+``contracts.PACKER_EXEMPT`` with why it cannot change the
+single-device program — a new static missing from both is a future
+wrong-program-served bug.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+
+from p2p_gossipprotocol_tpu.analysis.contracts import (
+    FINGERPRINT_ATTR_ALIASES, FINGERPRINT_EXEMPT, PACKER_EXEMPT)
+from p2p_gossipprotocol_tpu.analysis.core import Finding, rule
+
+_FUNC = (ast.FunctionDef, ast.AsyncFunctionDef)
+_KEYMAP_NAMES = ("_REFERENCE_INT_KEYS", "_SIM_INT_KEYS",
+                 "_SIM_FLOAT_KEYS", "_SIM_STR_KEYS")
+
+
+def _config_attr_map(tree):
+    """(source, {config-file key -> attr name}) from the key maps, or
+    (None, {})."""
+    for src in tree.package_sources():
+        maps = {}
+        for node in src.tree.body:
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Dict):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and \
+                            tgt.id in _KEYMAP_NAMES:
+                        for k, v in zip(node.value.keys,
+                                        node.value.values):
+                            if isinstance(k, ast.Constant) and \
+                                    isinstance(v, ast.Constant):
+                                maps[k.value] = v.value
+        if maps:
+            return src, maps
+    return None, {}
+
+
+def _fingerprinted_attrs(fn: ast.AST) -> set[str]:
+    """Attrs ``config_keys`` reads off its ``cfg`` parameter."""
+    cfg = fn.args.args[0].arg if fn.args.args else "cfg"
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == cfg:
+            out.add(node.attr)
+    return out
+
+
+def _exempt_category(key: str) -> str | None:
+    for pattern, cat in FINGERPRINT_EXEMPT.items():
+        if pattern.endswith("*"):
+            if fnmatch.fnmatch(key, pattern):
+                return cat
+        elif key == pattern:
+            return cat
+    return None
+
+
+@rule("fingerprint-exclusion",
+      "every config key is either in engines.config_keys or "
+      "classified exempt in contracts.FINGERPRINT_EXEMPT — exactly one")
+def check_fingerprint(tree):
+    defs = tree.defining("config_keys", kind=_FUNC)
+    cfg_src, keymap = _config_attr_map(tree)
+    if not defs or cfg_src is None:
+        return []
+    src, fn = defs[0]
+    included = _fingerprinted_attrs(fn)
+    findings = []
+    for key, attr in sorted(keymap.items()):
+        fingerprinted = attr in included or \
+            FINGERPRINT_ATTR_ALIASES.get(attr, key) in included
+        cat = _exempt_category(key)
+        if fingerprinted and cat is not None:
+            findings.append(Finding(
+                "fingerprint-exclusion", src.rel, fn.lineno,
+                f"config key {key!r} is classified exempt "
+                f"({cat}) but engines.config_keys fingerprints it — "
+                "a checkpoint would refuse to migrate across this "
+                "knob; fix the classification or the fingerprint"))
+        elif not fingerprinted and cat is None:
+            findings.append(Finding(
+                "fingerprint-exclusion", cfg_src.rel, fn.lineno,
+                f"config key {key!r} is neither fingerprinted by "
+                "engines.config_keys nor classified in "
+                "contracts.FINGERPRINT_EXEMPT — classify it: "
+                "trajectory keys enter the fingerprint, "
+                "how/where/watch keys get an exemption category"))
+    return findings
+
+
+def _aligned_statics(cls: ast.ClassDef) -> dict[str, int]:
+    """Underscore attrs assigned on self anywhere in the class."""
+    out = {}
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self" and \
+                        tgt.attr.startswith("_") and \
+                        not tgt.attr.startswith("__"):
+                    out.setdefault(tgt.attr, node.lineno)
+    return out
+
+
+def _signature_attrs(fn: ast.AST) -> set[str]:
+    sim = fn.args.args[0].arg if fn.args.args else "sim"
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == sim:
+            out.add(node.attr)
+    return out
+
+
+@rule("packer-signature",
+      "every resolved AlignedSimulator static appears in "
+      "fleet/packer.bucket_signature or contracts.PACKER_EXEMPT")
+def check_packer(tree):
+    sims = tree.defining("AlignedSimulator", kind=(ast.ClassDef,))
+    sigs = tree.defining("bucket_signature", kind=_FUNC)
+    if not sims or not sigs:
+        return []
+    sim_src, sim_cls = sims[0]
+    sig_src, sig_fn = sigs[0]
+    statics = _aligned_statics(sim_cls)
+    in_sig = _signature_attrs(sig_fn)
+    findings = []
+    for attr, lineno in sorted(statics.items()):
+        if attr in in_sig or attr in PACKER_EXEMPT:
+            continue
+        findings.append(Finding(
+            "packer-signature", sim_src.rel, lineno,
+            f"AlignedSimulator.{attr} is a resolved static that "
+            "appears in neither fleet/packer.bucket_signature nor "
+            "contracts.PACKER_EXEMPT — if it changes the compiled "
+            "round program, two different programs could share a "
+            "bucket (wrong results served); classify it"))
+    for attr in sorted(a for a in in_sig if a.startswith("_")):
+        if attr not in statics:
+            findings.append(Finding(
+                "packer-signature", sig_src.rel, sig_fn.lineno,
+                f"bucket_signature reads sim.{attr} but "
+                "AlignedSimulator never assigns it — a renamed or "
+                "removed static leaves the signature reading a ghost"))
+    return findings

@@ -1,0 +1,45 @@
+"""Rule ``telemetry-imports``: the telemetry package never imports jax.
+
+PR 10's zero-device-computation contract, as a static rule instead of a
+runtime test: if no module under ``p2p_gossipprotocol_tpu/telemetry/``
+can even NAME jax, telemetry can never add device work, force a sync,
+or perturb compilation — the bitwise on-vs-off parity suite
+(tests/test_telemetry.py) then only has to defend the host side.
+Covers ``import jax``, ``from jax...``, and lazy in-function imports
+alike (the runtime test this rule subsumes could only see import-time
+effects).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from p2p_gossipprotocol_tpu.analysis.contracts import (
+    TELEMETRY_BANNED_IMPORTS, TELEMETRY_PKG)
+from p2p_gossipprotocol_tpu.analysis.core import Finding, rule
+
+
+@rule("telemetry-imports",
+      "no module under telemetry/ imports jax (zero device "
+      "computation by construction)")
+def check(tree):
+    findings = []
+    for src in tree.package_sources():
+        if TELEMETRY_PKG not in src.rel:
+            continue
+        for node in ast.walk(src.tree):
+            mods = []
+            if isinstance(node, ast.Import):
+                mods = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                mods = [node.module]
+            for m in mods:
+                root = m.split(".")[0]
+                if root in TELEMETRY_BANNED_IMPORTS:
+                    findings.append(Finding(
+                        "telemetry-imports", src.rel, node.lineno,
+                        f"telemetry imports {m!r} — the observability "
+                        "plane is host-side by contract (zero device "
+                        "computation, bitwise on-vs-off); move "
+                        "device-touching code out of telemetry/"))
+    return findings

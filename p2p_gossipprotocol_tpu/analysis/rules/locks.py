@@ -1,0 +1,148 @@
+"""Rule ``lock-discipline``: lock-guarded state stays lock-guarded.
+
+The exact class of race PR 9's review caught by hand: the scheduler
+reserved request ids OUTSIDE the locked section, so two concurrent
+submits could share a rid (one client's registration silently
+overwritten, the survivor double-served).  The mechanical form of that
+contract: in any class that owns a ``threading.Lock``/``RLock``, an
+attribute that is ever WRITTEN under ``with self._lock:`` belongs to
+the lock — reading or writing it outside a held section in any other
+method is a race (targets ``serve/scheduler.py``, ``serve/service.py``,
+``telemetry/recorder.py``; deliberate lock-free fast paths are
+baseline entries with their justification, e.g. the recorder's
+``enabled`` bool).
+
+``__init__``/``__post_init__`` are exempt — construction happens
+before the object is shared.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from p2p_gossipprotocol_tpu.analysis.core import (Finding, dotted, rule,
+                                                  self_attr)
+
+_FUNC = (ast.FunctionDef, ast.AsyncFunctionDef)
+_EXEMPT_METHODS = {"__init__", "__post_init__", "__new__"}
+_MUTATORS = {"append", "appendleft", "add", "remove", "discard", "pop",
+             "popleft", "clear", "update", "extend", "insert",
+             "setdefault"}
+
+
+def _lock_attrs(cls: ast.ClassDef) -> set[str]:
+    """``self.X = threading.Lock()/RLock()`` attr names (any method)."""
+    out = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            d = dotted(node.value.func) or ""
+            if d.split(".")[-1] in ("Lock", "RLock"):
+                for tgt in node.targets:
+                    attr = self_attr(tgt)
+                    if attr:
+                        out.add(attr)
+    return out
+
+
+def _with_locks(node: ast.With, locks: set[str]) -> set[str]:
+    held = set()
+    for item in node.items:
+        attr = self_attr(item.context_expr)
+        if attr in locks:
+            held.add(attr)
+    return held
+
+
+def _written_attr(node: ast.AST) -> str | None:
+    """The ``self.X`` a statement writes/mutates, if any."""
+    if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for tgt in targets:
+            attr = self_attr(tgt)
+            if attr:
+                return attr
+            if isinstance(tgt, ast.Subscript):
+                attr = self_attr(tgt.value)
+                if attr:
+                    return attr
+    if isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr in _MUTATORS:
+        attr = self_attr(node.func.value)
+        if attr:
+            return attr
+    return None
+
+
+def _scan(node, locks, held, hits):
+    """Collect (attr, held_locks, node, is_write) for every ``self.X``
+    touch, tracking which locks are held lexically."""
+    if isinstance(node, ast.With):
+        newly = _with_locks(node, locks)
+        for item in node.items:
+            _scan(item.context_expr, locks, held, hits)
+        for child in node.body:
+            _scan(child, locks, held | newly, hits)
+        return
+    w = _written_attr(node)
+    if w is not None:
+        hits.append((w, frozenset(held), node, True))
+    if isinstance(node, ast.Attribute):
+        attr = self_attr(node)
+        if attr is not None:
+            hits.append((attr, frozenset(held), node, False))
+            return
+        _scan(node.value, locks, held, hits)
+        return
+    for child in ast.iter_child_nodes(node):
+        _scan(child, locks, held, hits)
+
+
+@rule("lock-discipline",
+      "attributes written under `with self._lock` must never be "
+      "read or written outside a held section of the same lock")
+def check(tree):
+    findings = []
+    for src in tree.package_sources():
+        for cls in [n for n in ast.walk(src.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            locks = _lock_attrs(cls)
+            if not locks:
+                continue
+            methods = [n for n in cls.body if isinstance(n, _FUNC)]
+            hits_by_method = {}
+            for m in methods:
+                hits = []
+                for stmt in m.body:
+                    _scan(stmt, locks, set(), hits)
+                hits_by_method[m.name] = hits
+            # pass 1: which attr belongs to which lock (written held)
+            owner: dict[str, str] = {}
+            for m in methods:
+                for attr, held, _node, is_write in hits_by_method[m.name]:
+                    if is_write and held and attr not in locks:
+                        owner.setdefault(attr, sorted(held)[0])
+            # pass 2: touches of owned attrs without the owning lock
+            # (deduped per line, the write spelling winning — an
+            # AugAssign registers both a write and its inner load)
+            for m in methods:
+                if m.name in _EXEMPT_METHODS:
+                    continue
+                per_line: dict[tuple, bool] = {}
+                for attr, held, node, is_write in \
+                        hits_by_method[m.name]:
+                    if attr in owner and owner[attr] not in held:
+                        key = (node.lineno, attr)
+                        per_line[key] = per_line.get(key, False) \
+                            or is_write
+                for (lineno, attr), is_write in sorted(per_line.items()):
+                    kind = "written" if is_write else "read"
+                    findings.append(Finding(
+                        "lock-discipline", src.rel, lineno,
+                        f"{cls.name}.{attr} is {kind} in {m.name}() "
+                        f"without holding self.{owner[attr]} (it is "
+                        "written under that lock elsewhere — PR 9 "
+                        "double-rid race class)"))
+    return findings

@@ -1,0 +1,61 @@
+"""Rule ``tracing-safety``: no host escapes inside traced functions.
+
+Every engine's bitwise contract assumes the compiled round program is a
+pure function of its operands.  A ``time.time()`` / ``random.*`` /
+``np.random`` call inside a function reachable from ``jax.jit`` /
+``pallas_call`` / ``shard_map`` either fails at trace time or — the
+dangerous case — executes ONCE at trace time and bakes a single host
+value into the program for every subsequent round.  ``.item()`` and
+``open()`` force a device sync / host I/O into the hot loop.  The
+traced set comes from :mod:`analysis.callgraph`'s walk out of the
+engines' round functions.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from p2p_gossipprotocol_tpu.analysis.callgraph import traced_functions
+from p2p_gossipprotocol_tpu.analysis.contracts import (HOST_ESCAPE_CALLS,
+                                                       HOST_ESCAPE_METHODS)
+from p2p_gossipprotocol_tpu.analysis.core import (Finding, dotted, rule,
+                                                  walk_calls)
+
+
+def _escape_reason(call: ast.Call) -> str | None:
+    d = dotted(call.func)
+    if d is not None:
+        for pattern, reason in HOST_ESCAPE_CALLS.items():
+            if pattern.endswith("."):
+                if d.startswith(pattern) or d == pattern[:-1]:
+                    return f"{d}() — {reason}"
+            elif d == pattern or d.startswith(pattern + "."):
+                return f"{d}() — {reason}"
+    if isinstance(call.func, ast.Attribute) and not call.args \
+            and not call.keywords \
+            and call.func.attr in HOST_ESCAPE_METHODS:
+        return (f".{call.func.attr}() — "
+                f"{HOST_ESCAPE_METHODS[call.func.attr]}")
+    return None
+
+
+@rule("tracing-safety",
+      "functions reachable from jit/pallas_call/shard_map entry points "
+      "must not call host clocks, host PRNGs, .item(), or open()")
+def check(tree):
+    findings = []
+    seen = set()
+    for t in traced_functions(tree):
+        for call in walk_calls(t.node):
+            reason = _escape_reason(call)
+            if reason is None:
+                continue
+            key = (t.source.rel, call.lineno, reason)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(Finding(
+                "tracing-safety", t.source.rel, call.lineno,
+                f"host escape {reason} inside traced function "
+                f"{t.qualname} (under trace via {t.via})"))
+    return findings

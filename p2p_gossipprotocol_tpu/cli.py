@@ -475,9 +475,16 @@ def _report_sir(res, *, n_peers, engine, args, metrics_lib,
             "new_infections": int(res.new_infections[i]),
             "live_peers": int(res.live_peers[i]),
         } for i in range(len(res.infected))]
-        with open(args.metrics_jsonl, "w") as fp:
-            metrics_lib.emit_jsonl(rows, fp, n_peers=n_peers,
-                                   mode="sir", engine=engine)
+        # tmp+rename so a kill mid-dump never leaves a torn metrics
+        # table (the write-discipline contract, docs/STATIC_ANALYSIS.md)
+        import io
+
+        from p2p_gossipprotocol_tpu.utils.logging import write_atomic
+
+        buf = io.StringIO()
+        metrics_lib.emit_jsonl(rows, buf, n_peers=n_peers,
+                               mode="sir", engine=engine)
+        write_atomic(args.metrics_jsonl, buf.getvalue())
     extinction = res.rounds_to_extinction()
     out = {
         "n_peers": n_peers,
@@ -522,10 +529,15 @@ def _report(res, sim, *, n_peers, engine, args, metrics_lib, clamps=None,
             if res.coverage[i] >= 0.999999 and res.frontier_size[i] == 0:
                 break
     if args.metrics_jsonl:
-        with open(args.metrics_jsonl, "w") as fp:
-            metrics_lib.emit_jsonl(metrics_lib.rows_from_result(res), fp,
-                                   n_peers=n_peers, mode=sim.mode,
-                                   engine=engine)
+        import io
+
+        from p2p_gossipprotocol_tpu.utils.logging import write_atomic
+
+        buf = io.StringIO()
+        metrics_lib.emit_jsonl(metrics_lib.rows_from_result(res), buf,
+                               n_peers=n_peers, mode=sim.mode,
+                               engine=engine)
+        write_atomic(args.metrics_jsonl, buf.getvalue())
     summary = metrics_lib.summarize(res, args.target_coverage)
     summary.pop("rounds", None)   # identical to rounds_run below
     out = {

@@ -222,11 +222,15 @@ class Scheduler:
         with self._lock:
             reqs = list(self.requests.values())
             n_queued = len(self.queue)
+            # n_rejected is written under the lock (submit) — read it
+            # in the same snapshot, not after (gossip-lint
+            # lock-discipline)
+            n_rejected = self.n_rejected
         lat = [r.t_result - r.t_enqueue for r in reqs
                if r.status == DONE and r.t_result is not None]
         out = {
             "submitted": len(reqs),
-            "rejected": self.n_rejected,
+            "rejected": n_rejected,
             "queued": n_queued,
             "running": sum(1 for r in reqs if r.status == RUNNING),
             "done": sum(1 for r in reqs if r.status == DONE),

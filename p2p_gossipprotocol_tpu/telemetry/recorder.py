@@ -316,7 +316,8 @@ class Recorder:
         the salvage/crash path it decorates."""
         try:
             if path is None:
-                d = directory or self.dump_dir
+                with self._lock:    # dump_dir is written under it
+                    d = directory or self.dump_dir
                 if not d:
                     return None
                 os.makedirs(d, exist_ok=True)
@@ -365,10 +366,12 @@ class Recorder:
             gauges = dict(self._gauges)
             kinds = dict(self._event_kinds)
             names = dict(self._span_names)
+            enabled = self.enabled
+            t0 = self._t0
         lines = ["# gossip telemetry (docs/OBSERVABILITY.md)",
                  "gossip_up 1",
-                 f"gossip_telemetry_enabled {int(self.enabled)}",
-                 f"gossip_uptime_s {round(time.time() - self._t0, 3)}"]
+                 f"gossip_telemetry_enabled {int(enabled)}",
+                 f"gossip_uptime_s {round(time.time() - t0, 3)}"]
         for k in sorted(counters):
             lines.append(f"gossip_{clean(k)} {counters[k]:g}")
         for k in sorted(gauges):

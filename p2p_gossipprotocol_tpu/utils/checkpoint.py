@@ -354,12 +354,11 @@ def _leaf_crcs(canonical: dict) -> dict:
 
 
 def _write_atomic(path: str, data: str) -> None:
-    tmp = path + ".tmp"
-    with open(tmp, "w") as fp:
-        fp.write(data)
-        fp.flush()
-        os.fsync(fp.fileno())
-    os.replace(tmp, path)
+    # one tmp+rename implementation repo-wide (utils/logging.py owns
+    # it so jax-free callers can share it)
+    from p2p_gossipprotocol_tpu.utils.logging import write_atomic
+
+    write_atomic(path, data)
 
 
 def _kill_hook(phase: str, rnd: int) -> None:

@@ -23,6 +23,23 @@ import time
 from pathlib import Path
 
 
+def write_atomic(path: str | Path, text: str) -> None:
+    """Whole-file artifact write with the torn-write discipline: land
+    the bytes in a sibling temp file, fsync, then ``os.replace`` — a
+    reader (or a crash) sees the old content or the new, never a
+    truncated half.  The jax-free twin of the checkpoint layer's
+    ``_write_atomic`` (which delegates here); gossip-lint's
+    write-discipline rule points bare ``open(path, "w")`` sites at
+    this helper."""
+    path = str(path)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fp:
+        fp.write(text)
+        fp.flush()
+        os.fsync(fp.fileno())
+    os.replace(tmp, path)
+
+
 def append_line(path: str | Path, text: str) -> None:
     """Append ``text`` as one line: O_APPEND open + a single
     ``write()`` — atomic w.r.t. the file offset under POSIX, so

@@ -1,0 +1,19 @@
+"""Fixture: the recorded-degrade idiom + chokepoint-only emission."""
+from p2p_gossipprotocol_tpu import telemetry
+
+
+def from_config(cfg, clamps):
+    overlap_mode = cfg.overlap_mode
+    if cfg.mode == "pull":
+        clamps.append("overlap_mode 1 with mode=pull -> 0 "
+                      "(no push pass to split)")
+        overlap_mode = 0
+    return overlap_mode
+
+
+def build_simulator(cfg, clamps=None):
+    clamps = [] if clamps is None else clamps
+    try:
+        return from_config(cfg, clamps)
+    finally:
+        telemetry.record_clamps(clamps, scope="build_simulator")

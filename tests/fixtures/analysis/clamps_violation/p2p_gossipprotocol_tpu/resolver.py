@@ -1,0 +1,14 @@
+"""Fixture: a silent knob degradation + a rogue ledger emission."""
+from p2p_gossipprotocol_tpu import telemetry
+
+
+def from_config(cfg, clamps):
+    overlap_mode = cfg.overlap_mode
+    if cfg.mode == "pull":
+        overlap_mode = 0              # silent degrade — no clamp
+    return overlap_mode
+
+
+def sneaky_site(clamps):
+    # emitting the typed ledger outside the two chokepoints
+    telemetry.record_clamps(clamps, scope="sneaky")

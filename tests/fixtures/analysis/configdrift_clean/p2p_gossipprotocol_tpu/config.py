@@ -1,0 +1,8 @@
+"""Fixture: validated == documented == consumed."""
+
+_REFERENCE_INT_KEYS = {}
+_SIM_INT_KEYS = {
+    "n_peers": "n_peers",
+}
+_SIM_FLOAT_KEYS = {}
+_SIM_STR_KEYS = {}

@@ -1,0 +1,5 @@
+"""Fixture: consumes the one validated key."""
+
+
+def build(cfg):
+    return cfg.n_peers

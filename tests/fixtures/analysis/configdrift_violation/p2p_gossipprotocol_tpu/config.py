@@ -1,0 +1,10 @@
+"""Fixture: three drift directions at once (see network.txt)."""
+
+_REFERENCE_INT_KEYS = {}
+_SIM_INT_KEYS = {
+    "n_peers": "n_peers",              # documented + consumed: clean
+    "ghost_key": "ghost_key",          # consumed but UNDOCUMENTED
+    "unused_key": "unused_key",        # undocumented AND unconsumed
+}
+_SIM_FLOAT_KEYS = {}
+_SIM_STR_KEYS = {}

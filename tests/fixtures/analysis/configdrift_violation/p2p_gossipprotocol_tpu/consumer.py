@@ -1,0 +1,5 @@
+"""Fixture: the consumption side of the drift triangle."""
+
+
+def build(cfg):
+    return cfg.n_peers + cfg.ghost_key

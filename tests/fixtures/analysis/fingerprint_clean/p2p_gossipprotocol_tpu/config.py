@@ -1,0 +1,12 @@
+"""Fixture config surface: every key classified exactly one way."""
+
+_REFERENCE_INT_KEYS = {
+    "n_peers": "n_peers",
+}
+_SIM_INT_KEYS = {
+    "prng_seed": "prng_seed",
+    "telemetry": "telemetry",          # exempt: plane
+    "mesh_devices": "mesh_devices",    # exempt: layout
+}
+_SIM_FLOAT_KEYS = {}
+_SIM_STR_KEYS = {}

@@ -1,0 +1,8 @@
+"""Fixture: trajectory keys fingerprinted, plane keys exempt."""
+
+
+def config_keys(cfg, n_peers=None):
+    return {
+        "n_peers": n_peers or cfg.n_peers,
+        "prng_seed": cfg.prng_seed,
+    }

@@ -1,0 +1,13 @@
+"""Fixture config surface: one key is neither fingerprinted nor
+classified (mystery_knob)."""
+
+_REFERENCE_INT_KEYS = {
+    "n_peers": "n_peers",
+}
+_SIM_INT_KEYS = {
+    "prng_seed": "prng_seed",
+    "telemetry": "telemetry",
+    "mystery_knob": "mystery_knob",
+}
+_SIM_FLOAT_KEYS = {}
+_SIM_STR_KEYS = {}

@@ -1,0 +1,11 @@
+"""Fixture: fingerprints an exempt plane key, misses a new knob."""
+
+
+def config_keys(cfg, n_peers=None):
+    return {
+        "n_peers": n_peers or cfg.n_peers,
+        "prng_seed": cfg.prng_seed,
+        # WRONG: telemetry is classified exempt (plane) — a checkpoint
+        # written with telemetry on would refuse to resume with it off
+        "telemetry": cfg.telemetry,
+    }

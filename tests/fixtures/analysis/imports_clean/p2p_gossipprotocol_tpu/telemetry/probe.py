@@ -1,0 +1,7 @@
+"""Fixture: host-only telemetry module."""
+import json
+import os
+
+
+def snapshot():
+    return {"pid": os.getpid(), "payload": json.dumps({})}

@@ -1,0 +1,7 @@
+"""Fixture: the telemetry plane naming jax (top-level AND lazy)."""
+import jax
+
+
+def capture():
+    from jax import profiler
+    return profiler

@@ -1,0 +1,26 @@
+"""Fixture: the post-fix scheduler — rid reserved inside the first
+locked section, every guarded touch under the lock."""
+import threading
+
+
+class Scheduler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next_rid = 0
+        self.requests = {}
+        self.queue = []
+
+    def submit(self, overrides):
+        with self._lock:
+            if len(self.queue) >= 64:
+                raise RuntimeError("queue full")
+            rid = self._next_rid
+            self._next_rid += 1
+        spec = self._resolve(overrides)
+        with self._lock:
+            self.requests[rid] = spec
+            self.queue.append(rid)
+        return rid
+
+    def _resolve(self, overrides):
+        return dict(overrides)

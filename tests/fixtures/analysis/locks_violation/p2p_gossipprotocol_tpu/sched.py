@@ -1,0 +1,31 @@
+"""Fixture: the PR 9 scheduler double-rid race, pre-fix shape.
+
+Two concurrent submits both read ``_next_rid`` OUTSIDE the lock, share
+a rid, and the second registration overwrites the first — the exact
+race PR 9's review caught by hand and the lock-discipline rule must
+flag mechanically.
+"""
+import threading
+
+
+class Scheduler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next_rid = 0
+        self.requests = {}
+        self.queue = []
+
+    def submit(self, overrides):
+        with self._lock:
+            if len(self.queue) >= 64:
+                raise RuntimeError("queue full")
+        rid = self._next_rid          # RACE: read outside the lock —
+        spec = self._resolve(overrides)   # two submits can share rid
+        with self._lock:
+            self._next_rid = max(self._next_rid, rid + 1)
+            self.requests[rid] = spec
+            self.queue.append(rid)
+        return rid
+
+    def _resolve(self, overrides):
+        return dict(overrides)

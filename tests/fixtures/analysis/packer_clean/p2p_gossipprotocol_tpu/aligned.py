@@ -1,0 +1,7 @@
+"""Fixture: every resolved static classified."""
+
+
+class AlignedSimulator:
+    def __post_init__(self):
+        self._pull_slots = 4
+        self._plan_cache = None   # contracts.PACKER_EXEMPT (host cache)

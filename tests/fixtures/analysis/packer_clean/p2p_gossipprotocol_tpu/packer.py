@@ -1,0 +1,5 @@
+"""Fixture: signature covers the live statics."""
+
+
+def bucket_signature(sim):
+    return (sim._pull_slots,)

@@ -1,0 +1,7 @@
+"""Fixture: a resolved static the packer signature never sees."""
+
+
+class AlignedSimulator:
+    def __post_init__(self):
+        self._pull_slots = 4
+        self._new_static = 1      # not in bucket_signature, not exempt

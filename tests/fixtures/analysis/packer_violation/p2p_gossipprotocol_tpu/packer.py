@@ -1,0 +1,8 @@
+"""Fixture: signature reads a ghost; misses a live static."""
+
+
+def bucket_signature(sim):
+    return (
+        sim._pull_slots,
+        sim._ghost_static,        # AlignedSimulator never assigns this
+    )

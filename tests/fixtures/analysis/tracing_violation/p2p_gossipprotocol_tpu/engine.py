@@ -1,0 +1,25 @@
+"""Fixture: host escapes inside a jit-traced round function."""
+import time
+
+import numpy as np
+import jax
+
+
+def _round(state, key):
+    t = time.time()                      # wall-clock under trace
+    noise = np.random.uniform()          # host PRNG under trace
+    x = state.sum().item()               # tracer -> host scalar
+    return state + t + noise + x
+
+
+def _helper(state):
+    return state * np.random.randint(4)  # reached via the call graph
+
+
+def _body(state, key):
+    return _helper(_round(state, key))
+
+
+def run(state, key):
+    fn = jax.jit(_body)
+    return fn(state, key)

@@ -1,0 +1,12 @@
+"""Fixture: the inline tmp+rename idiom."""
+import json
+import os
+
+
+def dump_rows(path, rows):
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fp:
+        json.dump(rows, fp)
+        fp.flush()
+        os.fsync(fp.fileno())
+    os.replace(tmp, path)

@@ -1,0 +1,7 @@
+"""Fixture: a bare artifact write a crash can tear."""
+import json
+
+
+def dump_rows(path, rows):
+    with open(path, "w") as fp:        # torn-write hazard
+        json.dump(rows, fp)

@@ -10,6 +10,7 @@ produce BITWISE-identical results to the legacy route (prow + host
 masking) on the same topology — not a statistical match."""
 
 import numpy as np
+import pytest
 
 from p2p_gossipprotocol_tpu.aligned import (AlignedSimulator,
                                             build_aligned)
@@ -103,6 +104,10 @@ def test_block_perm_convergence_parity():
         assert fused <= base + 2, (seed, base, fused)
 
 
+# slow: broadest mesh variant (the PR 5 budget rule) — the full-stack
+# unsharded bitwise cases above and test_auto_select's sharded
+# selection parity keep the fused overlay covered in tier-1
+@pytest.mark.slow
 def test_block_perm_sharded_bitwise(devices8):
     """Fused path across the device mesh: ytab slices by the shard's
     block offset, and 8-device results match the unsharded run

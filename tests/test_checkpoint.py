@@ -3,6 +3,7 @@ must continue bitwise-identically (SURVEY.md §5 — the subsystem the
 reference lacks)."""
 
 import numpy as np
+import pytest
 
 from p2p_gossipprotocol_tpu import graph
 from p2p_gossipprotocol_tpu.aligned import AlignedSimulator, build_aligned
@@ -495,6 +496,10 @@ def test_migrate_single_to_2d(tmp_path, devices8):
         n_msgs=64)
 
 
+# slow: one of the four writer->reader migration pairs (the PR 5
+# budget rule) — the other three pairs stay in tier-1, and the
+# preemption suite's cross-layout CLI resume exercises this direction
+@pytest.mark.slow
 def test_migrate_2d_to_sharded8(tmp_path, devices8):
     """Pair 3: 2-D mesh writer -> 1-D sharded N=8 reader."""
     from p2p_gossipprotocol_tpu.parallel import (

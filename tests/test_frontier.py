@@ -99,6 +99,10 @@ def test_solo_block_skip_bitwise(topo8, mode):
     assert_same(dense, sparse)
 
 
+# slow: the broadest solo composition (the PR 5 budget rule, joining
+# the six broadest sharded cases below) — per-feature skip parity
+# stays in tier-1 via the narrower cases above
+@pytest.mark.slow
 def test_solo_skip_composes_with_everything(topo8):
     """Skip x fanout x stagger x faults x fuse_update in one scenario —
     the compositions each add kernel operands next to the skip tables."""

@@ -78,6 +78,10 @@ def test_fuse_update_sharded_parity(devices8):
     _assert_bitwise(base, sh2, "2d-mesh")
 
 
+# slow: the broadest census composition (the PR 5 budget rule) —
+# kernel-vs-jnp census parity and the sharded parity case keep the
+# census covered in tier-1; mosaic_smoke re-checks censuses on-chip
+@pytest.mark.slow
 def test_census_fanout_parity():
     """Round-6 acceptance: the in-kernel census must stay bitwise-equal
     to the jnp census under bounded-fanout rumor mongering too (the

@@ -126,6 +126,10 @@ def test_pull_window_sharded_parity(devices8):
                                   np.asarray(sh.coverage))
 
 
+# slow: broadest mesh variant (the PR 5 budget rule) — the unsharded
+# parity case above and the shared-aligned_round inheritance tests in
+# test_auto_select keep the window covered in tier-1
+@pytest.mark.slow
 def test_pull_window_2d_mesh_parity(devices8):
     """The 2-D (msgs x peers) mesh inherits the windowed pull through
     the shared aligned_round — bitwise vs the unsharded windowed run."""

@@ -4,6 +4,8 @@ messageGenerationLoop (one message per message_interval,
 peer.cpp:357-377), instead of every rumor existing at round 0."""
 
 import numpy as np
+import pytest
+
 import jax
 
 from p2p_gossipprotocol_tpu import graph
@@ -122,6 +124,10 @@ def test_aligned_matches_edges_activation_dynamics():
     assert full.coverage[-1] == 1.0
 
 
+# slow: the broadest layout product (1-D + 2-D in one case) — the PR 5
+# budget rule; edges-sharded stagger parity and the aligned activation
+# tests above keep the schedule covered in tier-1
+@pytest.mark.slow
 def test_aligned_sharded_and_2d_bitwise_with_stagger(devices8):
     """Bitwise parity of the unsharded, 1-D sharded and 2-D mesh engines
     with the generation schedule on: the injection decision derives from

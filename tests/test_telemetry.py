@@ -3,7 +3,17 @@
 serving plane produce BITWISE-identical results with telemetry on or
 off, zero extra retraces — the clamp ledger absorbs every named clamp
 site as exactly one typed event, dumps are atomic and readable, and
-the serve server scrapes/captures live."""
+the serve server scrapes/captures live.
+
+The STRUCTURAL halves of two of these contracts are enforced
+statically by gossip-lint (tests/test_analysis.py,
+docs/STATIC_ANALYSIS.md) rather than at runtime: the telemetry
+package's jax-import ban (``telemetry-imports`` — the runtime suite
+could only ever observe import-time effects; the static rule also
+catches lazy in-function imports) and the telemetry_* fingerprint
+exclusion (``fingerprint-exclusion``, which checks EVERY key's
+classification, not one knob).  This module keeps the behavioral
+sides: bitwise parity, retrace counts, ledger semantics."""
 
 import json
 import os
@@ -366,12 +376,19 @@ def test_bitwise_parity_serve_and_trace_count(tmp_path):
 
 
 def test_fingerprint_excludes_telemetry_keys(tmp_path):
+    """RETIRED to gossip-lint: the full exclusion contract (every
+    config key either fingerprinted or classified exempt — telemetry_*
+    among them) is now the static ``fingerprint-exclusion`` rule,
+    enforced tree-wide by tests/test_analysis.py over
+    analysis/contracts.FINGERPRINT_EXEMPT.  One smoke assertion stays
+    so a broken engines.config_keys import path can't hide behind a
+    green lint."""
     from p2p_gossipprotocol_tpu.engines import config_keys
 
     cfg_off = NetworkConfig(_write_cfg(tmp_path))
     cfg_on = NetworkConfig(_write_cfg(
         tmp_path, "telemetry=1\ntelemetry_ring=128\n", name="on.txt"))
-    assert config_keys(cfg_off) == config_keys(cfg_on)
+    assert config_keys(cfg_off) == config_keys(cfg_on)   # smoke
 
 
 def test_roofline_counters_live(tmp_path):
