@@ -302,13 +302,14 @@ def _bench_aligned(n, n_msgs, degree, mode):
                                                 MAX_WORDS_X_ROWBLK,
                                                 n_msg_words)
 
+    # the auto rule lives in tuning/resolve.py (the -1-auto chokepoint,
+    # round 14) — bench rows and from_config builds select identically
+    from p2p_gossipprotocol_tpu.tuning import resolve as tuning_resolve
+
     bp_env = os.environ.get("GOSSIP_BENCH_BLOCK_PERM", "").strip()
-    if bp_env:
-        block_perm = bool(int(bp_env))
-    else:
-        block_perm = (n_msg_words(n_msgs) >= AUTO_BLOCK_PERM_MIN_WORDS
-                      and mode != "pull"
-                      and (roll_groups is None or roll_groups >= 2))
+    block_perm = tuning_resolve.heuristic_block_perm(
+        int(bp_env) if bp_env else -1, n_msg_words(n_msgs), mode,
+        degree, roll_groups, min_words=AUTO_BLOCK_PERM_MIN_WORDS)
     # In-kernel seen-update — opt-in (measured negative pre-census; the
     # in-kernel census changes its economics — measure_round6 re-A/Bs).
     fuse_update = bool(int(os.environ.get("GOSSIP_BENCH_FUSE_UPDATE", "0")))
@@ -331,8 +332,8 @@ def _bench_aligned(n, n_msgs, degree, mode):
         rowblk = int(rb_env)
     else:
         budget = MAX_WORDS_X_ROWBLK // (2 if fuse_update else 1)
-        rowblk = min(MAX_CONFIG_ROWBLK,
-                     max(8, budget // n_msg_words(n_msgs) // 8 * 8))
+        rowblk = tuning_resolve.heuristic_rowblk(
+            n_msg_words(n_msgs), budget, MAX_CONFIG_ROWBLK)
     # Windowed pull — DEFAULT ON since the on-chip A/Bs: -29.5% steady-
     # state ms/round on this exact config (256-round scans, the only
     # timing mode the tunnel can't distort), identical rounds and final
@@ -367,16 +368,19 @@ def _bench_aligned(n, n_msgs, degree, mode):
     graph_s = time.perf_counter() - t0
     plan = _fault_plan()
 
-    def _mk_sim(pw):
+    def _mk_sim(pw, fm=None, pd=None, ft=None):
+        kw = {}
+        if ft is not None:
+            kw["frontier_threshold"] = ft
         return AlignedSimulator(
             topo=topo, n_msgs=n_msgs, mode=mode,
             churn=ChurnConfig(rate=churn_rate, kill_round=1),
             max_strikes=3, liveness_every=liveness_every,
             message_stagger=stagger,
             fuse_update=fuse_update, pull_window=pw, faults=plan,
-            frontier_mode=frontier_mode,
-            prefetch_depth=prefetch_depth,
-            seed=0)
+            frontier_mode=frontier_mode if fm is None else fm,
+            prefetch_depth=prefetch_depth if pd is None else pd,
+            seed=0, **kw)
 
     try:
         sim = _mk_sim(pull_window)
@@ -385,6 +389,34 @@ def _bench_aligned(n, n_msgs, degree, mode):
             raise              # explicitly requested — surface the guard
         pull_window = False    # defaulted on, config can't support it
         sim = _mk_sim(False)
+    # The tuning chokepoint (round 14): resolve the row's auto statics
+    # against the persisted cache — a hit substitutes measured-best
+    # values from the bitwise-safe family (results identical, only the
+    # schedule changes) and the row records the provenance.  Explicit
+    # env knobs (GOSSIP_BENCH_FRONTIER=0/1, GOSSIP_BENCH_PREFETCH=0/2)
+    # are honored unchanged, so headline A/B rows stay comparable.
+    tune_sig = tuning_resolve.signature_for_sim(sim)
+    tuned = tuning_resolve.resolve_statics(
+        tune_sig,
+        requested={"frontier_mode": frontier_mode,
+                   "frontier_threshold": -1.0,
+                   "prefetch_depth": prefetch_depth},
+        heuristics={
+            "frontier_mode": int(tuning_resolve.heuristic_on(
+                frontier_mode, sim.interpret)),
+            "frontier_threshold":
+                tuning_resolve.heuristic_frontier_threshold(-1.0),
+            "prefetch_depth": tuning_resolve.heuristic_prefetch(
+                prefetch_depth, sim.interpret)},
+        legal={"frontier_mode": lambda v: v in (0, 1),
+               "frontier_threshold": lambda v:
+                   isinstance(v, (int, float)) and 0.0 < v <= 1.0,
+               "prefetch_depth": lambda v: v in (0, 2)})
+    if tuned.substituted:
+        st = tuned.statics
+        sim = _mk_sim(pull_window, fm=int(st["frontier_mode"]),
+                      pd=int(st["prefetch_depth"]),
+                      ft=float(st["frontier_threshold"]))
     state, topo2, rounds, wall = sim.run_to_coverage(
         target=TARGET_COV, max_rounds=MAX_ROUNDS, check_every=check_every)
     _check_converged(aligned_coverage(sim, state, topo2), rounds)
@@ -531,6 +563,20 @@ def _bench_aligned(n, n_msgs, degree, mode):
         "roll_groups": roll_groups,
         "faults": plan.to_spec() if plan else None,
         "rowblk": topo.rowblk,
+        # round 14: every row is a self-describing A/B artifact — the
+        # RESOLVED statics the run actually executed with, plus which
+        # seam picked them (tuning cache vs the open-coded heuristics)
+        "tuned_from": tuned.source,
+        "resolved_statics": {
+            "rowblk": topo.rowblk,
+            "block_perm": bool(block_perm),
+            "prefetch_depth": int(sim._prefetch),
+            "frontier_mode": int(sim._frontier_delta),
+            "frontier_threshold": round(sim.frontier_threshold, 8),
+            "overlap_mode": int(sim._overlap),
+            **({"serve_chunk": serve["serve_chunk"]}
+               if "serve_chunk" in serve else {}),
+        },
         **({"message_stagger": stagger} if stagger else {}),
         **({"block_perm": True} if block_perm else {}),
         **({"fuse_update": True} if fuse_update else {}),
@@ -623,6 +669,10 @@ def _bench_serve(n_req: int, n_peers: int, slots: int) -> dict:
     return {
         "serve_n": n_req, "serve_peers": n_peers,
         "serve_slots": slots,
+        # the admission cadence the loop actually ran with, and which
+        # seam resolved it (round 14 — cfg default -1 = auto-tuned)
+        "serve_chunk": svc.chunk,
+        "serve_chunk_from": svc.chunk_source,
         "serve_wall_s": round(wall, 4),
         "serve_p50_ms": stats["p50_ms"],
         "serve_p99_ms": stats["p99_ms"],

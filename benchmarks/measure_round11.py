@@ -20,11 +20,11 @@ with a different trajectory is not a result):
   asks for — aligned.project_exchange at 1B peers x 256 messages over
   a 64-host x 4-device pod, flat-DCN vs hier-DCN GB/round quoted
   closed-form (a model row; parity_ok is definitionally true).
-* on TPU, this step also RETRIES the still-pending measure_round10
-  window (ROADMAP item 4: the ``leak_recal`` κ-verification and the
-  overlap trace on silicon) — measure_round10.py resumes per-config
-  from its own landed rows, so the retry is free when they already
-  landed; the outcome is recorded as a ``round10_retry`` row.
+(The TPU-side retry of the still-pending measure_round10 rows — the
+``leak_recal`` κ-verification and the overlap trace on silicon,
+ROADMAP item 4 — used to piggyback on this step ad hoc; it is now a
+first-class ``round10_retry`` entry in tpu_watchdog.sh's data-driven
+step table, where pending follow-ups register in one place.)
 
 Run on the chip (watchdog chain step measure_round11):
     PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/measure_round11.py
@@ -35,7 +35,6 @@ GOSSIP_R11_ROUNDS (20), GOSSIP_R11_HOSTS (2), GOSSIP_R11_DEVS (4).
 """
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -181,20 +180,6 @@ def bench_tier_budget_1b(done):
           "parity_ok": True})
 
 
-def retry_round10(on_tpu: bool, done):
-    """ROADMAP item 4's still-pending TPU window: re-invoke
-    measure_round10 (it resumes per-config from its own landed rows —
-    the leak_recal κ verification and the overlap profile are the rows
-    that have never run on silicon).  CPU runs skip: the round-10 CPU
-    rows are committed and a re-run would measure nothing new."""
-    if not on_tpu or "round10_retry" in done:
-        return
-    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "measure_round10.py")
-    rc = subprocess.run([sys.executable, script]).returncode
-    emit({"config": "round10_retry", "rc": rc, "parity_ok": rc == 0})
-
-
 def main():
     global OUT
     backend = jax.default_backend()
@@ -208,7 +193,6 @@ def main():
               "rounds": rounds, "parity_ok": True})
     bench_hier_dcn(n, rounds, _HOSTS, _DEVS, done)
     bench_tier_budget_1b(done)
-    retry_round10(on_tpu, done)
     return 0
 
 

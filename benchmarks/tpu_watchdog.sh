@@ -109,14 +109,39 @@ record_fail() {
   fi
 }
 
-STEP_NAMES="bench mosaic_smoke measure_round4 measure_round5 measure_round6 measure_round7 measure_round8 measure_round9 measure_round10 measure_round11 measure_round12 measure_round13 baselines multihost longrun"
-# Headline first: a short tunnel window must yield the most important
-# artifact.  bench keeps its file contract (ONE parsed line) and only
-# stamps when the line really came from the chip.  longrun is the
-# elastic-checkpoint rehearsal: a checkpointed 1M-peer run that rides
-# the exit-75 resume contract across tunnel windows — a preempted
-# window leaves a salvage checkpoint and the next window CONTINUES it
-# (--resume via the .resume stamp) instead of restarting from round 0.
+# ONE data-driven pending-step table: "name:timeout" per entry, in run
+# order.  A measure_roundN step needs nothing but its row here — the
+# default command rule is `python benchmarks/<name>.py` — so new
+# rounds and follow-up retries register in one place (the round-11
+# round10_retry used to hide inside measure_round11's own main;
+# round10_retry is now a first-class entry that re-invokes
+# measure_round10, which resumes per-config from its landed rows, so
+# the still-pending leak_recal/overlap chip rows land the moment a
+# window opens — ROADMAP item 4).  Headline first: a short tunnel
+# window must yield the most important artifact.  bench keeps its file
+# contract (ONE parsed line) and only stamps when the line really came
+# from the chip.  measure_round14 is the autotuner sweep + tuned-vs-
+# default A/B — it also re-tunes any signatures the live drift gauge
+# marked stale since the last window (retune_requested events).
+# longrun is the elastic-checkpoint rehearsal: a checkpointed 1M-peer
+# run that rides the exit-75 resume contract across tunnel windows — a
+# preempted window leaves a salvage checkpoint and the next window
+# CONTINUES it (--resume via the .resume stamp) instead of restarting
+# from round 0.
+STEPS="bench:1800 mosaic_smoke:2400 measure_round4:4800 \
+  measure_round5:3600 measure_round6:3600 measure_round7:3600 \
+  measure_round8:3600 measure_round9:3600 measure_round10:3600 \
+  measure_round11:3600 round10_retry:3600 measure_round12:3600 \
+  measure_round13:3600 measure_round14:3600 baselines:4800 \
+  multihost:1800 longrun:1800"
+STEP_NAMES=$(for s in $STEPS; do echo -n "${s%%:*} "; done)
+step_tmo() {
+  local s
+  for s in $STEPS; do
+    [ "${s%%:*}" = "$1" ] && { echo "${s##*:}"; return; }
+  done
+  echo 3600
+}
 LONGRUN_CK=benchmarks/results/longrun_ck
 step_cmd() {
   case $1 in
@@ -127,28 +152,10 @@ rec = json.load(open('benchmarks/results/bench_r5_tpu.json'))
 sys.exit(0 if rec.get('platform') in ('tpu', 'axon') and rec.get('value')
          else 1)
 PY" ;;
-    mosaic_smoke)   echo "python benchmarks/mosaic_smoke.py" ;;
-    measure_round4) echo "python benchmarks/measure_round4.py" ;;
-    measure_round5) echo "python benchmarks/measure_round5.py" ;;
-    measure_round6) echo "python benchmarks/measure_round6.py" ;;
-    measure_round7) echo "python benchmarks/measure_round7.py" ;;
-    measure_round8) echo "python benchmarks/measure_round8.py" ;;
-    measure_round9) echo "python benchmarks/measure_round9.py" ;;
-    measure_round10) echo "python benchmarks/measure_round10.py" ;;
-    # round-11 A/B (flat vs two-tier DCN bytes) — on TPU the same step
-    # also retries the still-pending measure_round10 rows (leak_recal
-    # on silicon + the overlap trace; ROADMAP item 4), since
-    # measure_round10.py resumes per-config from its landed rows
-    measure_round11) echo "python benchmarks/measure_round11.py" ;;
-    # round-12: the resident continuous-batching server vs the
-    # sequential and batch-offline shapes, plus the Poisson
-    # offered-load latency sweep (p50/p99 admission-to-result)
-    measure_round12) echo "python benchmarks/measure_round12.py" ;;
-    # round-13: telemetry-plane overhead A/B (262k + 1M, on/off,
-    # bitwise parity) plus a live serve /metrics scrape and an
-    # on-demand bounded profile capture round-tripped through
-    # trace_top's summarizer
-    measure_round13) echo "python benchmarks/measure_round13.py" ;;
+    # ROADMAP item 4's pending chip rows (leak_recal κ on silicon +
+    # the overlap trace): measure_round10 resumes per-config, so this
+    # is free when they already landed
+    round10_retry)  echo "python benchmarks/measure_round10.py" ;;
     baselines)      echo "python benchmarks/run_baselines.py" ;;
     multihost)
       # the multi-host step is DELEGATED to the runtime supervisor
@@ -170,23 +177,8 @@ PY" ;;
         --n-peers 1048576 --engine aligned --mode pushpull --rounds 64 \
         --checkpoint-every 8 --checkpoint-dir $LONGRUN_CK $resume \
         --metrics-jsonl benchmarks/results/longrun_metrics.jsonl" ;;
-  esac
-}
-step_tmo() {
-  case $1 in
-    bench) echo 1800 ;; mosaic_smoke) echo 2400 ;;
-    measure_round4) echo 4800 ;; measure_round5) echo 3600 ;;
-    measure_round6) echo 3600 ;;
-    measure_round7) echo 3600 ;;
-    measure_round8) echo 3600 ;;
-    measure_round9) echo 3600 ;;
-    measure_round10) echo 3600 ;;
-    measure_round11) echo 3600 ;;
-    measure_round12) echo 3600 ;;
-    measure_round13) echo 3600 ;;
-    baselines) echo 4800 ;;
-    multihost) echo 1800 ;;
-    longrun) echo 1800 ;;
+    # default rule: a measurement step IS its benchmarks/ script
+    *)              echo "python benchmarks/$1.py" ;;
   esac
 }
 

@@ -1139,13 +1139,18 @@ class AlignedSimulator:
         # Frontier-sparse resolution (after ``interpret`` is known —
         # auto keys off it): block skipping needs a push pass to skip
         # in; the delta exchange engages only when a sharded engine
-        # passes its FrontierCarry into the round.
+        # passes its FrontierCarry into the round.  The auto rules live
+        # in tuning/resolve.py — THE chokepoint every -1-auto static
+        # resolves through (gossip-lint tuning-chokepoint), so the
+        # autotuner and the direct-constructor path share one rule set.
+        from p2p_gossipprotocol_tpu.tuning import resolve as tuning_resolve
+
         if self.frontier_mode not in (-1, 0, 1):
             raise ValueError("frontier_mode must be -1 (auto), 0, or 1")
         if not 0.0 < self.frontier_threshold <= 1.0:
             raise ValueError("frontier_threshold must be in (0, 1]")
-        fr_on = (self.frontier_mode == 1
-                 or (self.frontier_mode == -1 and not self.interpret))
+        fr_on = tuning_resolve.heuristic_on(self.frontier_mode,
+                                            self.interpret)
         self._frontier_skip = fr_on and self.mode in ("push", "pushpull")
         self._frontier_delta = fr_on
         # Round-10 schedule knobs (both bitwise-identical, both keyed
@@ -1154,18 +1159,16 @@ class AlignedSimulator:
         # hides the sharded exchange behind the self-shard kernel.
         if self.prefetch_depth not in (-1, 0, 2):
             raise ValueError("prefetch_depth must be -1 (auto), 0, or 2")
-        self._prefetch = (2 if self.prefetch_depth == 2
-                          or (self.prefetch_depth == -1
-                              and not self.interpret) else 0)
+        self._prefetch = tuning_resolve.heuristic_prefetch(
+            self.prefetch_depth, self.interpret)
         if self.overlap_mode not in (-1, 0, 1):
             raise ValueError("overlap_mode must be -1 (auto), 0, or 1")
         # the split needs a push pass to split and the block-perm
         # overlay's block-granular locality (a row-granular permutation
         # scatters every y block's rows across all shards); it engages
         # only when aligned_round actually runs sharded (n_shards > 1)
-        self._overlap = ((self.overlap_mode == 1
-                          or (self.overlap_mode == -1
-                              and not self.interpret))
+        self._overlap = (tuning_resolve.heuristic_on(self.overlap_mode,
+                                                     self.interpret)
                          and self.topo.ytab is not None
                          and self.mode in ("push", "pushpull"))
         # Hierarchical two-tier exchange (round 11): resolved here so
@@ -1178,9 +1181,8 @@ class AlignedSimulator:
         if self.hier_hosts < 0 or self.hier_devs < 0:
             raise ValueError("hier_hosts/hier_devs must be >= 0")
         self._hier = (self.hier_hosts > 1
-                      and (self.hier_mode == 1
-                           or (self.hier_mode == -1
-                               and not self.interpret)))
+                      and tuning_resolve.heuristic_on(self.hier_mode,
+                                                      self.interpret))
         # Liveness (strikes/rewire) runs whenever peers can die — without
         # churn no neighbor is ever observed dead, so the pass is skipped
         # statically and the strike plane is never allocated.
@@ -1250,14 +1252,18 @@ class AlignedSimulator:
         # rolls).  An EXPLICIT block_perm=0/1 is honored, except that
         # illegal combinations degrade with a recorded clamp instead of
         # erroring the run — same seam as every other engine ceiling.
+        # The rule itself lives in tuning/resolve.py (the -1-auto
+        # chokepoint); block_perm is NOT cache-tunable — the permuted
+        # overlay changes the trajectory, so it keys the tuning
+        # signature instead.
+        from p2p_gossipprotocol_tpu.tuning import resolve as \
+            tuning_resolve
+
         W = n_msg_words(n_msgs)
         groups = cfg.roll_groups or None
-        if cfg.block_perm < 0:
-            block_perm = (W >= AUTO_BLOCK_PERM_MIN_WORDS
-                          and cfg.mode != "pull" and n_slots >= 2
-                          and (groups is None or groups >= 2))
-        else:
-            block_perm = bool(cfg.block_perm)
+        block_perm = tuning_resolve.heuristic_block_perm(
+            cfg.block_perm, W, cfg.mode, n_slots, groups,
+            min_words=AUTO_BLOCK_PERM_MIN_WORDS)
         if block_perm and groups is not None and groups <= 1 \
                 and n_slots > 1:
             clamps.append(
@@ -1327,8 +1333,8 @@ class AlignedSimulator:
         # — e.g. 129 messages: 258 msgs -> 9 words -> rowblk 448, but 5
         # words x 448 busts the 2048 budget).
         budget = MAX_WORDS_X_ROWBLK // (2 if cfg.fuse_update else 1)
-        rowblk = min(MAX_CONFIG_ROWBLK,
-                     max(8, budget // n_msg_words(n_msgs) // 8 * 8))
+        rowblk = tuning_resolve.heuristic_rowblk(
+            n_msg_words(n_msgs), budget, MAX_CONFIG_ROWBLK)
         topo = build_aligned(seed=cfg.prng_seed, n=n, n_slots=n_slots,
                              degree_law=law,
                              powerlaw_alpha=cfg.powerlaw_alpha,
@@ -1336,35 +1342,93 @@ class AlignedSimulator:
                              rowblk=rowblk,
                              roll_groups=cfg.roll_groups or None,
                              block_perm=block_perm)
-        return cls(topo=topo, n_msgs=n_msgs, mode=cfg.mode,
-                   fanout=cfg.fanout,
-                   churn=ChurnConfig(rate=cfg.churn_rate),
-                   byzantine_fraction=byz,
-                   n_honest_msgs=n_honest,
-                   max_strikes=cfg.max_missed_pings,
-                   # probe cadence from the config's own intervals: one
-                   # liveness sweep per ping_interval of message rounds
-                   # (reference defaults 13 s / 5 s → every 3rd round).
-                   # Sub-second message intervals keep their real ratio
-                   # (ping=13, message=0.5 → every 26th round); only a
-                   # zero/negative denominator falls back to 1:1.
-                   liveness_every=max(1, round(
-                       cfg.get_ping_interval()
-                       / (cfg.get_message_interval()
-                          if cfg.get_message_interval() > 0
-                          else cfg.get_ping_interval()))),
-                   message_stagger=cfg.message_stagger,
-                   fuse_update=bool(cfg.fuse_update),
-                   pull_window=pull_window,
-                   faults=(plan if plan and plan.engine_active()
-                           else None),
-                   frontier_mode=cfg.frontier_mode,
-                   frontier_threshold=cfg.frontier_threshold,
-                   prefetch_depth=cfg.prefetch_depth,
-                   overlap_mode=cfg.overlap_mode,
-                   hier_hosts=hier_hosts, hier_devs=hier_devs,
-                   hier_mode=cfg.hier_mode,
-                   seed=cfg.prng_seed)
+        # The tuning chokepoint (round 14, docs/ARCHITECTURE.md "The
+        # tuning seam"): every remaining -1 auto resolves HERE — a
+        # cache hit for this build's signature (topology shape, W,
+        # mode/fanout, backend, statics family) wins over the
+        # heuristic, a miss falls back to the exact open-coded rules
+        # (registered in tuning/resolve.py), and every substitution is
+        # a typed ``tuned`` ledger event.  Only the bitwise-identical
+        # statics are substitutable, so a tuned run equals the untuned
+        # run bit-for-bit (tests/test_tuning.py).  Explicit configured
+        # values are honored unconditionally.
+        interpret = jax.default_backend() not in ("tpu", "axon")
+        sig = tuning_resolve.signature(
+            rows=topo.rows, rowblk=topo.rowblk, n_slots=n_slots,
+            n_words=W, mode=cfg.mode, fanout=cfg.fanout,
+            backend="interpret" if interpret else "compiled",
+            n_shards=n_shards, block_perm=block_perm,
+            roll_groups=topo.roll_groups or 0,
+            fuse_update=int(bool(cfg.fuse_update)),
+            pull_window=int(pull_window),
+            hier=(hier_hosts, hier_devs))
+        tuned = tuning_resolve.resolve_statics(
+            sig,
+            requested={
+                "frontier_mode": cfg.frontier_mode,
+                "frontier_threshold": cfg.frontier_threshold,
+                "prefetch_depth": cfg.prefetch_depth,
+                "overlap_mode": cfg.overlap_mode,
+                "hier_mode": cfg.hier_mode,
+            },
+            heuristics={
+                "frontier_mode": int(tuning_resolve.heuristic_on(
+                    cfg.frontier_mode, interpret)),
+                "frontier_threshold":
+                    tuning_resolve.heuristic_frontier_threshold(
+                        cfg.frontier_threshold),
+                "prefetch_depth": tuning_resolve.heuristic_prefetch(
+                    cfg.prefetch_depth, interpret),
+                "overlap_mode": int(tuning_resolve.heuristic_on(
+                    cfg.overlap_mode, interpret)),
+                "hier_mode": int(tuning_resolve.heuristic_on(
+                    cfg.hier_mode, interpret)),
+            },
+            legal={
+                "frontier_mode": lambda v: v in (0, 1),
+                "frontier_threshold":
+                    lambda v: isinstance(v, (int, float))
+                    and 0.0 < v <= 1.0,
+                "prefetch_depth": lambda v: v in (0, 2),
+                # the self/remote split needs the block-perm overlay's
+                # block-granular locality and a push pass — the same
+                # rule the explicit-knob clamp above records
+                "overlap_mode": lambda v: v in (0, 1) and (
+                    v == 0 or (block_perm and cfg.mode != "pull")),
+                "hier_mode": lambda v: v in (0, 1),
+            })
+        st = tuned.statics
+        sim = cls(topo=topo, n_msgs=n_msgs, mode=cfg.mode,
+                  fanout=cfg.fanout,
+                  churn=ChurnConfig(rate=cfg.churn_rate),
+                  byzantine_fraction=byz,
+                  n_honest_msgs=n_honest,
+                  max_strikes=cfg.max_missed_pings,
+                  # probe cadence from the config's own intervals: one
+                  # liveness sweep per ping_interval of message rounds
+                  # (reference defaults 13 s / 5 s → every 3rd round).
+                  # Sub-second message intervals keep their real ratio
+                  # (ping=13, message=0.5 → every 26th round); only a
+                  # zero/negative denominator falls back to 1:1.
+                  liveness_every=max(1, round(
+                      cfg.get_ping_interval()
+                      / (cfg.get_message_interval()
+                         if cfg.get_message_interval() > 0
+                         else cfg.get_ping_interval()))),
+                  message_stagger=cfg.message_stagger,
+                  fuse_update=bool(cfg.fuse_update),
+                  pull_window=pull_window,
+                  faults=(plan if plan and plan.engine_active()
+                          else None),
+                  frontier_mode=int(st["frontier_mode"]),
+                  frontier_threshold=float(st["frontier_threshold"]),
+                  prefetch_depth=int(st["prefetch_depth"]),
+                  overlap_mode=int(st["overlap_mode"]),
+                  hier_hosts=hier_hosts, hier_devs=hier_devs,
+                  hier_mode=int(st["hier_mode"]),
+                  seed=cfg.prng_seed)
+        sim._tuning = tuned
+        return sim
 
     # ------------------------------------------------------------------
     def traffic_model(self, frontier_fill: float | None = None,

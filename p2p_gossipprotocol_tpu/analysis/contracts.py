@@ -169,6 +169,26 @@ TELEMETRY_PKG = "p2p_gossipprotocol_tpu/telemetry/"
 TELEMETRY_BANNED_IMPORTS = ("jax",)
 
 # ---------------------------------------------------------------------
+# Rule: tuning-chokepoint (PR 12: the closed-loop autotuner routes
+# every -1-auto performance static through tuning/resolve.py — one
+# seam for cache substitution, one registry of heuristic fallbacks.
+# An auto-sentinel test (``X == -1`` / ``X < 0``) on a known auto
+# static anywhere else re-opens the open-coded-heuristic scatter the
+# resolver chokepoint deleted: the cache can no longer substitute
+# there, and the heuristic forks.  Validation guards — membership
+# tests like ``not in (-1, 0, 1)`` and raise-only branches — are not
+# resolution and stay exempt).
+
+#: statics whose -1 spelling means "auto" — each resolves through
+#: tuning/resolve.py (the file defining ``resolve_statics``; its
+#: registered heuristic_* fallbacks included)
+AUTO_STATICS = {
+    "block_perm", "frontier_mode", "frontier_threshold",
+    "prefetch_depth", "overlap_mode", "hier_mode", "sir_fuse",
+    "serve_chunk",
+}
+
+# ---------------------------------------------------------------------
 # Rule: config-drift (PR 1 onward: every key config.py validates is
 # documented in network.txt and consumed by some engine/plane —
 # "parsed then ignored" is the reference's bug this repo exists to not

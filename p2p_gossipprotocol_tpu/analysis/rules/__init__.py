@@ -7,4 +7,5 @@ PR/doc lives in docs/STATIC_ANALYSIS.md.
 from p2p_gossipprotocol_tpu.analysis.rules import (clamps,  # noqa: F401
                                                    configsurface,
                                                    fingerprint, imports,
-                                                   locks, tracing, writes)
+                                                   locks, tracing,
+                                                   tuningseam, writes)

@@ -1,0 +1,127 @@
+"""Rule ``tuning-chokepoint``: -1-auto statics resolve in one place.
+
+PR 12 closed the tuning loop: every ``-1``-auto performance static
+(``contracts.AUTO_STATICS`` — frontier_mode, prefetch_depth,
+block_perm, serve_chunk, ...) resolves through ``tuning/resolve.py``,
+where a tuning-cache hit can substitute a measured-best value and the
+open-coded heuristics live as registered fallbacks.  An auto-sentinel
+test on one of those statics anywhere else — ``X == -1`` or ``X < 0``
+— is the seam rotting: a fresh open-coded resolution the cache can
+never reach and the heuristic registry no longer owns.
+
+Exempt, because they are validation rather than resolution:
+
+* membership tests (``X not in (-1, 0, 1)`` guards) — different AST
+  shape, never matched;
+* comparisons inside an ``if`` whose body only raises (the
+  fail-fast-on-bad-value idiom);
+* the resolver module itself — located by its defining symbol
+  ``resolve_statics`` (fixtures mimic the layout), so the registered
+  ``heuristic_*`` fallbacks that legitimately test the sentinel are
+  where the contract says they belong.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from p2p_gossipprotocol_tpu.analysis.contracts import AUTO_STATICS
+from p2p_gossipprotocol_tpu.analysis.core import Finding, rule
+
+_FUNC = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _const_val(node: ast.AST):
+    """The literal value of a Constant, including the ``-1`` spelling
+    (UnaryOp(USub, Constant(1)) in the AST)."""
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub) \
+            and isinstance(node.operand, ast.Constant):
+        v = node.operand.value
+        return -v if isinstance(v, (int, float)) else None
+    if isinstance(node, ast.Constant):
+        return node.value
+    return None
+
+
+def _static_name(node: ast.AST) -> str | None:
+    """The terminal name of ``X`` / ``obj.X`` when it is a known auto
+    static."""
+    name = None
+    if isinstance(node, ast.Name):
+        name = node.id
+    elif isinstance(node, ast.Attribute):
+        name = node.attr
+    return name if name in AUTO_STATICS else None
+
+
+def _is_sentinel_test(cmp: ast.Compare) -> str | None:
+    """The static name when ``cmp`` is an auto-sentinel resolution
+    test: ``<static> == -1`` or ``<static> < 0`` (and their mirrored
+    spellings)."""
+    if len(cmp.ops) != 1 or len(cmp.comparators) != 1:
+        return None
+    op = cmp.ops[0]
+    left, right = cmp.left, cmp.comparators[0]
+    # mirrored constant-first spelling: -1 == X
+    if _static_name(left) is None and _static_name(right) is not None:
+        left, right = right, left
+        if isinstance(op, ast.Lt):      # 0 < X is not a sentinel test
+            return None
+    name = _static_name(left)
+    if name is None:
+        return None
+    val = _const_val(right)
+    if isinstance(op, ast.Eq) and val == -1:
+        return name
+    if isinstance(op, ast.Lt) and val == 0:
+        return name
+    return None
+
+
+def _raise_only_tests(tree: ast.Module) -> set[int]:
+    """ids of Compare nodes inside ``if`` tests whose body only raises
+    (validation guards, exempt)."""
+    out: set[int] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.If):
+            continue
+        body = [s for s in node.body
+                if not (isinstance(s, ast.Expr)
+                        and isinstance(s.value, ast.Constant))]
+        if body and all(isinstance(s, ast.Raise) for s in body):
+            for sub in ast.walk(node.test):
+                if isinstance(sub, ast.Compare):
+                    out.add(id(sub))
+    return out
+
+
+def _resolver_files(tree) -> set[str]:
+    return {src.rel
+            for src, _fn in tree.defining("resolve_statics",
+                                          kind=_FUNC)}
+
+
+@rule("tuning-chokepoint",
+      "-1-auto performance statics resolve through tuning/resolve.py "
+      "(its registered heuristic fallbacks included), nowhere else")
+def check(tree):
+    findings = []
+    resolver = _resolver_files(tree)
+    for src in tree.package_sources():
+        if src.rel in resolver:
+            continue
+        exempt = _raise_only_tests(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Compare) or id(node) in exempt:
+                continue
+            name = _is_sentinel_test(node)
+            if name is None:
+                continue
+            findings.append(Finding(
+                "tuning-chokepoint", src.rel, node.lineno,
+                f"auto sentinel of {name!r} resolved outside "
+                "tuning/resolve.py — route the -1 decision through "
+                "tuning.resolve (resolve_statics for cache-eligible "
+                "statics, a registered heuristic_* fallback "
+                "otherwise) so the autotuner keeps one seam"))
+    return findings

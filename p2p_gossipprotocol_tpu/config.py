@@ -361,7 +361,12 @@ class NetworkConfig:
         # gather must be well under the dense plane transfer to pay for
         # its bitmap+scatter overhead; 2*K words of idx+val vs L words
         # dense -> a 1/64 cap bounds the sparse gather at ~3% of dense).
-        self.frontier_threshold = 1.0 / 64.0
+        # -1 (the default) = AUTO: the tuning chokepoint resolves it —
+        # a tuning-cache hit for this shape wins, else the 1/64 rule
+        # (tuning/resolve.py; any explicit value in (0, 1] is honored).
+        # The capacity is bitwise-safe at any value (sparse == dense by
+        # seen-set monotonicity), which is what makes it tunable.
+        self.frontier_threshold = -1.0
         # Round-10 schedule knobs, all -1 = AUTO (engaged on the
         # compiled TPU path, off under interpret — the frontier_mode
         # rule; all three are bitwise-identical to the legacy schedule,
@@ -413,7 +418,12 @@ class NetworkConfig:
         self.serve_slots = 8             # slots per resident bucket
         self.serve_queue_max = 64        # bounded admission queue
         self.serve_max_buckets = 4       # resident signature buckets
-        self.serve_chunk = 8             # rounds per admission boundary
+        # rounds per admission boundary; -1 (default) = AUTO via the
+        # tuning chokepoint (cache hit wins, else the classic 8 —
+        # tuning/resolve.py; explicit values >= 1 honored).  Chunking
+        # only paces admission: every served scenario is bitwise its
+        # solo run at any chunk (tests/test_serve.py), so it is tunable.
+        self.serve_chunk = -1
         self.serve_rounds = 0            # per-scenario cap; 0 = rounds/64
         self.serve_target = 0.99         # retirement coverage target
         self.serve_results = ""          # served-rows JSONL (append)
@@ -556,9 +566,12 @@ class NetworkConfig:
             if getattr(self, k) < 0:
                 raise ConfigError(f"{k} must be non-negative")
         for k in ("serve_slots", "serve_queue_max", "serve_max_buckets",
-                  "serve_chunk", "telemetry_ring"):
+                  "telemetry_ring"):
             if getattr(self, k) < 1:
                 raise ConfigError(f"{k} must be >= 1")
+        if self.serve_chunk != -1 and self.serve_chunk < 1:
+            raise ConfigError(
+                "serve_chunk must be >= 1, or -1 (auto-tuned)")
         if not (0.0 < self.serve_target < 1.0):
             raise ConfigError(
                 "serve_target must be in (0, 1) — a served scenario "
@@ -587,8 +600,11 @@ class NetworkConfig:
             raise ConfigError("block_perm must be -1 (auto), 0, or 1")
         if self.frontier_mode not in (-1, 0, 1):
             raise ConfigError("frontier_mode must be -1 (auto), 0, or 1")
-        if not (0.0 < self.frontier_threshold <= 1.0):
-            raise ConfigError("frontier_threshold must be in (0, 1]")
+        if self.frontier_threshold != -1.0 and \
+                not (0.0 < self.frontier_threshold <= 1.0):
+            raise ConfigError(
+                "frontier_threshold must be in (0, 1], or -1 "
+                "(auto-tuned)")
         if self.prefetch_depth not in (-1, 0, 2):
             raise ConfigError(
                 "prefetch_depth must be -1 (auto), 0 (pipelined), or 2 "

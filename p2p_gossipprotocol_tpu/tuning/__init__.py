@@ -1,0 +1,29 @@
+"""Closed-loop autotuner (docs/ARCHITECTURE.md "The tuning seam").
+
+* :mod:`~p2p_gossipprotocol_tpu.tuning.resolve` — THE chokepoint every
+  ``-1``-auto performance static resolves through: explicit value >
+  cache hit (bitwise-safe statics only) > the registered open-coded
+  heuristic, each substitution a typed ``tuned`` ledger event;
+* :mod:`~p2p_gossipprotocol_tpu.tuning.cache` — the persisted tuning
+  cache, keyed like the fleet packer's bucket signature, written with
+  the checkpoint plane's atomic + CRC + schema discipline
+  (``GOSSIP_TUNING_CACHE`` env; ``off`` disables — zero config knobs);
+* :mod:`~p2p_gossipprotocol_tpu.tuning.search` — the offline sweep:
+  enumerate the LEGAL static space (the engines' own clamp rules gate
+  candidates), time short calibrated runs, persist the winner
+  (``python -m p2p_gossipprotocol_tpu.tuning`` / ``make tune``);
+* online: the telemetry roofline's drift gauge marks a signature stale
+  (``retune_requested``) and the watchdog's tune step re-sweeps it.
+
+Hard contract (ROADMAP item 5): tuned values are statics from the
+bitwise-identical family only, so tuned runs equal untuned runs
+bit-for-bit; tuned >= hand-picked defaults on every landed bench row;
+zero new config knobs.
+
+``resolve``/``cache`` are stdlib-only (no jax) so the telemetry plane
+may import them; ``search`` drives real engines and is CLI-side.
+"""
+
+from p2p_gossipprotocol_tpu.tuning import cache, resolve  # noqa: F401
+
+__all__ = ["cache", "resolve"]

@@ -1,0 +1,92 @@
+"""``python -m p2p_gossipprotocol_tpu.tuning`` — the offline sweep CLI.
+
+    python -m p2p_gossipprotocol_tpu.tuning network.txt \
+        [--n-peers N] [--rounds R] [--repeats K] [--cache PATH] \
+        [--force] [--serve] [--stale]
+
+Sweeps the legal static space for the config (tuning/search.py), times
+candidates with short calibrated runs, and persists the winner in the
+tuning cache (``--cache`` > ``GOSSIP_TUNING_CACHE`` > the repo
+default).  ``--force`` re-sweeps a signature that is already cached;
+``--serve`` also sweeps the serving loop's ``serve_chunk`` cadence;
+``--stale`` lists signatures the drift gauge has marked for retune
+(the watchdog's tune step re-sweeps its configured shapes, which
+rewrites them).  Exit 0 on a stored (or already-fresh) entry.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m p2p_gossipprotocol_tpu.tuning",
+        description="offline autotuner sweep (docs/PERFORMANCE.md "
+                    "'Round 14')")
+    ap.add_argument("config", help="network.txt-format config file")
+    ap.add_argument("--n-peers", type=int, default=None)
+    ap.add_argument("--rounds", type=int, default=8,
+                    help="rounds per timed candidate scan (default 8)")
+    ap.add_argument("--repeats", type=int, default=2,
+                    help="timed scans per candidate; min wins "
+                         "(default 2)")
+    ap.add_argument("--cache", default=None,
+                    help="cache file (default GOSSIP_TUNING_CACHE, "
+                         "then benchmarks/results/tuning_cache.json)")
+    ap.add_argument("--force", action="store_true",
+                    help="re-sweep even if the signature is cached")
+    ap.add_argument("--serve", action="store_true",
+                    help="also sweep the serving loop's serve_chunk")
+    ap.add_argument("--engine", default=None,
+                    help="override the config's engine (the tuner "
+                         "needs the aligned family; a config built "
+                         "for engine=edges tunes nothing)")
+    ap.add_argument("--stale", action="store_true",
+                    help="list stale-marked signatures and exit")
+    args = ap.parse_args(argv)
+
+    from p2p_gossipprotocol_tpu.tuning import cache as tuning_cache
+
+    if args.stale:
+        for key in tuning_cache.stale_signatures(args.cache):
+            print(key)
+        return 0
+
+    from p2p_gossipprotocol_tpu.config import ConfigError, NetworkConfig
+
+    try:
+        cfg = NetworkConfig(args.config)
+    except ConfigError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if args.engine:
+        cfg.engine = args.engine
+    elif cfg.engine == "edges":
+        # the scale path is what has statics to tune; say so rather
+        # than dying on a stock reference config
+        print("[tune] config says engine=edges (no tunable statics) "
+              "— tuning the aligned scale path instead; pass "
+              "--engine edges to refuse", file=sys.stderr)
+        cfg.engine = "aligned"
+
+    from p2p_gossipprotocol_tpu.engines import probe_backend
+    from p2p_gossipprotocol_tpu.tuning import search
+
+    probe_backend()
+    entry = search.tune_config(
+        cfg, n_peers=args.n_peers, rounds=args.rounds,
+        repeats=args.repeats, path=args.cache, force=args.force,
+        log=lambda *a: print(*a, file=sys.stderr))
+    if args.serve:
+        search.tune_serve_chunk(
+            cfg, path=args.cache,
+            log=lambda *a: print(*a, file=sys.stderr))
+    print(json.dumps(entry, indent=1, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

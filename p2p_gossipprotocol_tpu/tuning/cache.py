@@ -1,0 +1,257 @@
+"""Persisted tuning cache: signature -> measured-best performance statics.
+
+One JSON file maps tuning signatures (:func:`resolve.signature` — the
+fleet packer's bucket-signature *shape*: topology shape, message width,
+mode/fanout, backend, statics family) to the statics the offline sweep
+(:mod:`tuning.search`) measured best for that shape, with the
+checkpoint plane's artifact discipline applied to a host-side cache:
+
+* **atomic writes** — every mutation rewrites the whole file via
+  ``utils.logging.write_atomic`` (tmp + fsync + rename), so a reader
+  never sees a torn cache;
+* **per-entry CRC32** — each entry carries a CRC over its canonical
+  JSON form; a mismatch names the entry and the resolver falls back to
+  the heuristic for that signature instead of trusting half-written
+  values;
+* **schema pin** — a cache written by a newer build is a named
+  :class:`StaleTuningSchema`, never a misread;
+* **named errors, never a crash** — every defect class
+  (:class:`CorruptTuningCache` for torn/unreadable files and CRC
+  mismatches, :class:`StaleTuningSchema` for schema drift) derives from
+  :class:`TuningCacheError`; :func:`lookup` catches them all, emits one
+  typed ``tuning_cache_error`` ledger event, and answers None — the
+  heuristic fallback — because a corrupt *cache* must never take down a
+  *run* (the cache only ever chooses between bitwise-identical
+  schedules).
+
+Location: the ``GOSSIP_TUNING_CACHE`` environment variable only — the
+tuner adds ZERO config keys (the ROADMAP item-5 contract).  Unset, the
+cache lives at ``benchmarks/results/tuning_cache.json`` in the repo
+(where ``measure_round14`` commits the landed CPU entries);
+``GOSSIP_TUNING_CACHE=off`` disables lookups entirely (the A/B
+drivers' default arm, and the escape hatch).
+
+This module is stdlib-only (no jax) so the telemetry plane's roofline
+tracker can mark signatures stale without violating its
+zero-device-computation contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import zlib
+
+SCHEMA_VERSION = 1
+ENV_CACHE = "GOSSIP_TUNING_CACHE"
+_OFF = ("off", "0", "none", "disabled")
+
+#: default cache location (repo-relative): the committed artifact the
+#: watchdog's measure_round14 step refreshes.
+DEFAULT_CACHE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "benchmarks", "results",
+    "tuning_cache.json")
+
+
+class TuningCacheError(Exception):
+    """Base of every named tuning-cache defect — callers that must not
+    crash catch exactly this (:func:`lookup` does, answering None)."""
+
+
+class CorruptTuningCache(TuningCacheError):
+    """Torn/unreadable cache file, or a CRC mismatch naming the bad
+    entry."""
+
+
+class StaleTuningSchema(TuningCacheError):
+    """Cache schema newer than this build understands."""
+
+
+def cache_path() -> str | None:
+    """The active cache file, or None when tuning is disabled
+    (``GOSSIP_TUNING_CACHE=off``)."""
+    raw = os.environ.get(ENV_CACHE)
+    if raw is None:
+        return DEFAULT_CACHE
+    raw = raw.strip()
+    if not raw or raw.lower() in _OFF:
+        return None
+    return raw
+
+
+def sig_key(sig: tuple) -> str:
+    """Stable string form of a tuning signature (the JSON map key)."""
+    return "|".join(str(s) for s in sig)
+
+
+def _entry_crc(entry: dict) -> int:
+    """CRC32 over the entry's canonical JSON form, ``crc32`` excluded."""
+    body = {k: v for k, v in entry.items() if k != "crc32"}
+    return zlib.crc32(json.dumps(body, sort_keys=True).encode()) \
+        & 0xFFFFFFFF
+
+
+# one read-modify-write at a time per process; cross-process safety
+# comes from the atomic rename (last writer wins, readers never torn)
+_LOCK = threading.RLock()
+
+# memoized parse keyed by (path, mtime, size) — resolve consults the
+# cache once per simulator build, and a sweep builds hundreds
+_MEMO: dict = {}
+
+
+def load(path: str | None = None) -> dict:
+    """Parse + verify the cache; returns ``{sig_key: entry}`` (empty
+    when the file does not exist).  Raises the NAMED defect:
+    :class:`CorruptTuningCache` for an unparseable/torn file or a CRC
+    mismatch (naming the entry), :class:`StaleTuningSchema` for a
+    newer schema."""
+    path = path or cache_path()
+    if path is None or not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as fp:
+            doc = json.load(fp)
+    except (OSError, ValueError) as e:
+        raise CorruptTuningCache(
+            f"tuning cache {path} is torn or unreadable "
+            f"({type(e).__name__}: {e})") from e
+    if not isinstance(doc, dict) or "entries" not in doc:
+        raise CorruptTuningCache(
+            f"tuning cache {path} has no entries block "
+            "(not a tuning cache?)")
+    if int(doc.get("schema", 0)) > SCHEMA_VERSION:
+        raise StaleTuningSchema(
+            f"tuning cache {path} schema {doc.get('schema')} is newer "
+            f"than this build's {SCHEMA_VERSION} — upgrade, or retune "
+            "with this build")
+    entries = doc["entries"]
+    for key, entry in entries.items():
+        if _entry_crc(entry) != int(entry.get("crc32", -1)):
+            raise CorruptTuningCache(
+                f"tuning cache {path}: CRC mismatch in entry {key!r} "
+                "— the entry cannot be trusted (retune, or delete the "
+                "cache)")
+    return entries
+
+
+def _load_memo(path: str) -> dict:
+    try:
+        st = os.stat(path)
+        stamp = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        stamp = None
+    with _LOCK:
+        hit = _MEMO.get(path)
+        if hit is not None and hit[0] == stamp:
+            return hit[1]
+    entries = load(path)        # may raise — caller classifies
+    with _LOCK:
+        _MEMO[path] = (stamp, entries)
+    return entries
+
+
+def lookup(sig: tuple, path: str | None = None) -> dict | None:
+    """The resolver's read: the entry for ``sig`` — or None on a miss,
+    a stale-marked entry (drift requested a retune; the heuristic rules
+    serve until the next sweep lands), a disabled cache, or ANY cache
+    defect (named error recorded as one typed ``tuning_cache_error``
+    ledger event; the run proceeds on the heuristics — never a
+    crash)."""
+    path = path or cache_path()
+    if path is None:
+        return None
+    try:
+        entries = _load_memo(path)
+    except TuningCacheError as e:
+        from p2p_gossipprotocol_tpu.telemetry.recorder import recorder
+
+        recorder().event("tuning_cache_error",
+                         error=type(e).__name__, detail=str(e))
+        return None
+    entry = entries.get(sig_key(sig))
+    if entry is None or entry.get("stale"):
+        return None
+    return entry
+
+
+def _rewrite(path: str, entries: dict) -> None:
+    from p2p_gossipprotocol_tpu.utils.logging import write_atomic
+
+    doc = {"schema": SCHEMA_VERSION,
+           "written_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+           "entries": entries}
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    write_atomic(path, json.dumps(doc, sort_keys=True, indent=1) + "\n")
+    with _LOCK:
+        _MEMO.pop(path, None)
+
+
+def store(sig: tuple, statics: dict, *, ms_per_round: float,
+          default_ms_per_round: float, note: dict | None = None,
+          path: str | None = None) -> dict:
+    """Write/replace the entry for ``sig`` (read-modify-write under the
+    atomic-rename discipline).  A pre-existing corrupt cache is
+    replaced wholesale — the sweep's fresh measurements are the
+    recovery path the corruption runbook names."""
+    path = path or cache_path()
+    if path is None:
+        raise TuningCacheError(
+            "tuning cache is disabled (GOSSIP_TUNING_CACHE=off) — "
+            "nowhere to store the sweep result")
+    with _LOCK:   # serialize in-process writers; rename wins across
+        try:
+            entries = load(path)
+        except TuningCacheError:
+            entries = {}
+        entry = {
+            "signature": list(sig),
+            "statics": dict(statics),
+            "ms_per_round": round(float(ms_per_round), 6),
+            "default_ms_per_round":
+                round(float(default_ms_per_round), 6),
+            "tuned_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "stale": False,
+        }
+        if note:
+            entry["note"] = dict(note)
+        entry["crc32"] = _entry_crc(entry)
+        entries[sig_key(sig)] = entry
+        _rewrite(path, entries)
+        return entry
+
+
+def mark_stale(sig: tuple, path: str | None = None) -> bool:
+    """Flag the entry for ``sig`` stale (the drift gauge's retune
+    request): lookups skip it until the next sweep rewrites it.
+    Returns whether an entry was marked.  Never raises — this runs on
+    the telemetry plane's chunk path."""
+    try:
+        path = path or cache_path()
+        if path is None:
+            return False
+        with _LOCK:
+            entries = load(path)
+            entry = entries.get(sig_key(sig))
+            if entry is None or entry.get("stale"):
+                return False
+            entry["stale"] = True
+            entry["stale_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+            entry["crc32"] = _entry_crc(entry)
+            _rewrite(path, entries)
+            return True
+    except (TuningCacheError, OSError):
+        return False
+
+
+def stale_signatures(path: str | None = None) -> list[str]:
+    """Signature keys currently marked stale (the retune work list the
+    watchdog's tune step cashes)."""
+    try:
+        return sorted(k for k, e in load(path).items()
+                      if e.get("stale"))
+    except TuningCacheError:
+        return []

@@ -1,0 +1,271 @@
+"""THE resolver chokepoint for ``-1``-auto performance statics.
+
+Every place the repo used to open-code a "-1 means auto, pick the rule"
+decision (``aligned.AlignedSimulator.from_config`` / ``__post_init__``,
+``aligned_sir``, the serving loop's ``serve_chunk``) now resolves
+through this module, so the closed-loop autotuner has ONE seam to
+substitute measured-best values through — and gossip-lint's
+``tuning-chokepoint`` rule keeps it that way (an auto-sentinel test on
+a known static outside this file is a finding).
+
+Resolution order, per static:
+
+1. an EXPLICIT configured value (anything but the auto sentinel) is
+   honored unconditionally — the tuner never overrides a human;
+2. a cache hit (:mod:`tuning.cache`, keyed by :func:`signature`) wins
+   over the heuristic — but only for the statics in :data:`TUNABLE`,
+   the family proven **bitwise-identical** across values by the repo's
+   parity suites (frontier/prefetch/overlap/hier/sir_fuse pick HOW the
+   same blocks move, never what a round computes; ``serve_chunk`` only
+   paces admission boundaries, and every served scenario is bitwise its
+   solo run at any chunk).  Values that fail the caller's legality
+   check are rejected with a typed ``tuning_rejected`` event and fall
+   through;
+3. the registered HEURISTIC — the exact open-coded rule that shipped
+   before the tuner existed (kept here verbatim so the untuned path
+   cannot drift).
+
+Every cache substitution is recorded as one typed ``tuned`` telemetry
+event (always-on ledger) and in the returned :class:`Resolved` record,
+which rides the built simulator as ``sim._tuning`` — bench rows,
+fleet/serve result rows, and the live roofline read provenance from it.
+
+Deliberately NOT tunable: ``block_perm``, ``rowblk``, ``roll_groups``,
+``pull_window``, ``fuse_update``.  Those statics shape the overlay (a
+different row-block grid draws different block rolls) or the VMEM
+budget that shapes it, so substituting them would change the
+trajectory — the tuner's hard contract is bitwise-identical results.
+Their heuristics still live here (the chokepoint centralizes every
+auto rule), they are recorded in the SIGNATURE instead (a family
+component), and the search space documents them as
+identity-changing (docs/PERFORMANCE.md "Round 14").
+
+stdlib-only (no jax): the telemetry roofline tracker computes
+signatures on its chunk path under the zero-device-computation
+contract.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from p2p_gossipprotocol_tpu.tuning import cache as tuning_cache
+
+#: cache-substitutable statics — ONLY the bitwise-safe family (see
+#: module docstring; the parity tests behind each: test_frontier.py,
+#: test_prefetch.py, test_overlap.py, test_hier.py, test_sir_fuse.py,
+#: test_serve.py, test_tuning.py).
+TUNABLE = ("frontier_mode", "frontier_threshold", "prefetch_depth",
+           "overlap_mode", "hier_mode", "sir_fuse", "serve_chunk")
+
+#: signature schema tag — bump when the tuple layout changes so old
+#: cache entries miss instead of misresolving.
+SIG_VERSION = "tune-v1"
+
+#: the serving loop's default admission-boundary cadence (rounds per
+#: chunk) — the value ``config.serve_chunk`` shipped with before it
+#: grew the -1 auto spelling.
+SERVE_CHUNK_DEFAULT = 8
+
+#: the frontier delta-exchange capacity default, as a fraction of each
+#: shard's packed words (aligned.FRONTIER_THRESHOLD_DEFAULT re-exports
+#: this value; the derivation lives there).
+FRONTIER_THRESHOLD_DEFAULT = 1.0 / 64.0
+
+
+# ---------------------------------------------------------------------
+# Registered heuristic fallbacks — the open-coded rules, verbatim.
+
+def heuristic_on(requested: int, interpret: bool) -> bool:
+    """The shared auto rule for the 0/1 schedule knobs (frontier_mode,
+    overlap_mode, hier_mode): -1 = on for the compiled path, off under
+    interpret (the round-6/8/10 inversion precedent); 0/1 force."""
+    return requested == 1 or (requested == -1 and not interpret)
+
+
+def heuristic_prefetch(requested: int, interpret: bool) -> int:
+    """prefetch_depth auto rule: the manual double-buffered stream (2)
+    on the compiled path, the BlockSpec pipeline (0) under interpret."""
+    if requested == 2 or (requested == -1 and not interpret):
+        return 2
+    return 0
+
+
+def heuristic_sir_fuse(requested: int, interpret: bool,
+                       has_ytab: bool) -> bool:
+    """sir_fuse auto rule: fuse on the compiled path when the overlay
+    carries the block-perm index table (the permute prep only vanishes
+    with ytab)."""
+    return (requested == 1
+            or (requested == -1 and not interpret and has_ytab))
+
+
+def heuristic_block_perm(requested: int, n_words: int, mode: str,
+                         n_slots: int, roll_groups: int | None,
+                         min_words: int = 4) -> bool:
+    """from_config's fused-overlay AUTO rule (round 6, measured: -43%
+    ms/round at W=8, a wash at W=1 — ``min_words`` is
+    aligned.AUTO_BLOCK_PERM_MIN_WORDS).  NOT cache-tunable: the
+    block-granular permutation draws a different overlay, so a
+    substitution would change the trajectory — it enters the tuning
+    SIGNATURE instead."""
+    if requested < 0:
+        return (n_words >= min_words and mode != "pull"
+                and n_slots >= 2
+                and (roll_groups is None or roll_groups >= 2))
+    return bool(requested)
+
+
+def heuristic_rowblk(n_words: int, budget: int, cap: int) -> int:
+    """The VMEM-budget row-block rule (round 6: wide blocks at small W
+    — ``budget``/``cap`` are aligned.MAX_WORDS_X_ROWBLK (halved under
+    fuse_update) and aligned.MAX_CONFIG_ROWBLK).  NOT cache-tunable:
+    the row-block grid shapes the block-roll neighbor map."""
+    return min(cap, max(8, budget // n_words // 8 * 8))
+
+
+def heuristic_frontier_threshold(requested: float) -> float:
+    """frontier_threshold auto rule: -1 = the 1/64 capacity default
+    (aligned.FRONTIER_THRESHOLD_DEFAULT has the derivation)."""
+    return (FRONTIER_THRESHOLD_DEFAULT if requested == -1
+            else float(requested))
+
+
+def heuristic_serve_chunk(requested: int) -> int:
+    """serve_chunk auto rule: -1 = the 8-round admission cadence the
+    serving plane shipped with."""
+    return SERVE_CHUNK_DEFAULT if requested == -1 else int(requested)
+
+
+# ---------------------------------------------------------------------
+# Signatures.
+
+def signature(*, rows: int, rowblk: int, n_slots: int, n_words: int,
+              mode: str, fanout: int, backend: str, n_shards: int,
+              block_perm: bool, roll_groups: int, fuse_update: int,
+              pull_window: int, hier: tuple = (0, 0)) -> tuple:
+    """The tuning cache key: the fleet packer's bucket-signature SHAPE
+    — topology shape (rows x rowblk x slots), message width, mode and
+    fanout, backend (compiled vs interpret — the round-6/8/10
+    inversions make these different regimes), shard count, and the
+    statics FAMILY (overlay family + the identity-changing statics the
+    tuner must not substitute).  Narrower than the packer's signature
+    on purpose: per-scenario arrays (seeds, churn schedules, fault
+    plans) don't change which schedule is fastest, so scenarios that
+    pack into different buckets still share one tuning entry."""
+    return (SIG_VERSION, int(rows), int(rowblk), int(n_slots),
+            int(n_words), str(mode), int(fanout), str(backend),
+            int(n_shards), bool(block_perm), int(roll_groups),
+            int(fuse_update), int(pull_window),
+            int(hier[0]), int(hier[1]))
+
+
+def signature_for_sim(sim) -> tuple:
+    """The signature of an already-built simulator (sharded wrappers
+    expose their solo core as ``_inner``; plain attribute reads only —
+    safe on the telemetry plane)."""
+    inner = getattr(sim, "_inner", sim)
+    topo = inner.topo
+    return signature(
+        rows=topo.rows, rowblk=topo.rowblk, n_slots=topo.n_slots,
+        n_words=int(getattr(inner, "n_words", 1) or 1),
+        mode=str(getattr(inner, "mode", "sir")),
+        fanout=int(getattr(inner, "fanout", 0) or 0),
+        backend="interpret" if inner.interpret else "compiled",
+        n_shards=int(getattr(sim, "n_shards", 1) or 1),
+        block_perm=topo.ytab is not None,
+        roll_groups=int(topo.roll_groups or 0),
+        fuse_update=int(bool(getattr(inner, "fuse_update", 0))),
+        pull_window=int(bool(getattr(inner, "pull_window", 0))),
+        hier=(int(getattr(inner, "hier_hosts", 0) or 0),
+              int(getattr(inner, "hier_devs", 0) or 0)))
+
+
+def serve_signature(slots: int, rounds: int) -> tuple:
+    """serve_chunk's cache key: the serving loop paces ALL resident
+    buckets with one chunk length, so the key is the loop's own shape
+    (slot width x per-scenario round budget), not any one scenario's."""
+    return (SIG_VERSION, "serve", int(slots), int(rounds))
+
+
+# ---------------------------------------------------------------------
+# The chokepoint.
+
+@dataclass
+class Resolved:
+    """One build's resolution record (rides the simulator as
+    ``sim._tuning``): the signature, every resolved static, and the
+    provenance bench/fleet/serve rows report as ``tuned_from``."""
+
+    signature: tuple
+    statics: dict
+    source: str                      # "cache" | "heuristic"
+    substituted: tuple = ()          # statics the cache overrode
+    heuristics: dict = field(default_factory=dict)
+
+
+def resolve_statics(sig: tuple, requested: dict, heuristics: dict,
+                    legal: dict | None = None) -> Resolved:
+    """Resolve every static in ``requested`` (name -> configured
+    value; -1 is the auto sentinel for every tunable static) against
+    the cache entry for ``sig``, falling back to ``heuristics`` (name
+    -> the open-coded rule's value).  ``legal`` maps a name to a
+    predicate a cache value must pass (the engine's own clamp rules —
+    an illegal cached value is rejected+recorded, never applied).
+
+    Explicit values always win; cache values substitute only for
+    statics still at their auto sentinel AND listed in
+    :data:`TUNABLE`."""
+    from p2p_gossipprotocol_tpu.telemetry.recorder import recorder
+
+    entry = tuning_cache.lookup(sig)
+    cached = (entry or {}).get("statics", {})
+    out: dict = {}
+    subbed: list = []
+    used_cache = False
+    for name, req in requested.items():
+        if req != -1:                       # explicit: always honored
+            out[name] = req
+            continue
+        val = heuristics[name]
+        if name in TUNABLE and name in cached:
+            cand = cached[name]
+            ok = legal.get(name, _always)(cand) if legal else True
+            if ok:
+                used_cache = True
+                out[name] = cand
+                if cand != val:
+                    subbed.append(name)
+                    recorder().event(
+                        "tuned", static=name, value=cand,
+                        heuristic=val,
+                        signature=tuning_cache.sig_key(sig))
+                continue
+            recorder().event(
+                "tuning_rejected", static=name, value=cand,
+                signature=tuning_cache.sig_key(sig),
+                detail="cached value fails this build's legality "
+                       "rules — heuristic used")
+        out[name] = val
+    return Resolved(signature=sig, statics=out,
+                    source="cache" if used_cache else "heuristic",
+                    substituted=tuple(subbed),
+                    heuristics=dict(heuristics))
+
+
+def _always(_v) -> bool:
+    return True
+
+
+def resolve_serve_chunk(requested: int, *, slots: int,
+                        rounds: int) -> tuple[int, str]:
+    """The serving loop's chunk cadence through the chokepoint:
+    ``(resolved_chunk, tuned_from)``.  -1 = auto (cache hit or the
+    8-round default); explicit values are honored."""
+    res = resolve_statics(
+        serve_signature(slots, rounds),
+        requested={"serve_chunk": int(requested)},
+        heuristics={"serve_chunk": SERVE_CHUNK_DEFAULT},
+        legal={"serve_chunk": lambda v: isinstance(v, int)
+               and not isinstance(v, bool) and v >= 1})
+    return int(res.statics["serve_chunk"]), res.source

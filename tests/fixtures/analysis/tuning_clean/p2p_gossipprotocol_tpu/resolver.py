@@ -1,0 +1,20 @@
+"""Clean fixture's resolver module — defines ``resolve_statics``, so
+the sentinel tests in its registered heuristic fallbacks are where the
+tuning-chokepoint contract says they belong."""
+
+
+def heuristic_prefetch(prefetch_depth, interpret):
+    return 2 if prefetch_depth == -1 and not interpret else 0
+
+
+def heuristic_block_perm(block_perm, n_words):
+    if block_perm < 0:
+        return n_words >= 4
+    return bool(block_perm)
+
+
+def resolve_statics(sig, requested, heuristics):
+    out = {}
+    for name, req in requested.items():
+        out[name] = heuristics[name] if req == -1 else req
+    return out

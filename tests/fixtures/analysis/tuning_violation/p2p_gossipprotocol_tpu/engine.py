@@ -1,0 +1,21 @@
+"""Violating fixture: -1-auto statics resolved inline, outside the
+tuning/resolve.py chokepoint — the open-coded scatter PR 12 deleted."""
+
+
+class Engine:
+    def __init__(self, prefetch_depth=-1, frontier_mode=-1,
+                 interpret=True):
+        if prefetch_depth not in (-1, 0, 2):
+            raise ValueError("prefetch_depth must be -1, 0, or 2")
+        # VIOLATION: the auto sentinel resolved here, so a tuning-cache
+        # hit can never substitute and the heuristic forks
+        self._prefetch = (2 if prefetch_depth == -1 and not interpret
+                          else 0)
+        # VIOLATION: same scatter, the block_perm < 0 spelling
+        self._frontier = (frontier_mode == -1 and not interpret)
+
+
+def pick_block_perm(block_perm, n_words):
+    if block_perm < 0:          # VIOLATION: inline auto-select
+        return n_words >= 4
+    return bool(block_perm)

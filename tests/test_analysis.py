@@ -37,7 +37,7 @@ FIXTURES = Path(__file__).resolve().parent / "fixtures" / "analysis"
 EXPECTED_RULES = {
     "tracing-safety", "lock-discipline", "clamp-chokepoint",
     "fingerprint-exclusion", "packer-signature", "write-discipline",
-    "telemetry-imports", "config-drift",
+    "telemetry-imports", "config-drift", "tuning-chokepoint",
 }
 
 
@@ -71,7 +71,7 @@ def test_tree_is_clean_at_head():
 
 
 def test_rule_catalog_complete():
-    """All eight contract rules are registered, each with a one-line
+    """All nine contract rules are registered, each with a one-line
     contract string (the --list-rules surface)."""
     assert EXPECTED_RULES <= set(RULES)
     for rid, (fn, contract) in RULES.items():
@@ -203,6 +203,26 @@ def test_config_drift_three_directions():
 
 def test_config_drift_quiet_when_reconciled():
     assert _fixture("configdrift_clean", "config-drift") == []
+
+
+def test_tuning_rule_flags_inline_auto_resolution():
+    """Both sentinel spellings — ``X == -1`` and ``X < 0`` — on known
+    auto statics are flagged outside the resolver module, while the
+    ``not in (-1, 0, 2)`` validation guard in the same fixture stays
+    quiet."""
+    fs = _fixture("tuning_violation", "tuning-chokepoint")
+    msgs = [f.message for f in fs]
+    assert any("'prefetch_depth'" in m for m in msgs), msgs
+    assert any("'frontier_mode'" in m for m in msgs), msgs
+    assert any("'block_perm'" in m for m in msgs), msgs
+    assert len(fs) == 3, [f.render() for f in fs]
+
+
+def test_tuning_rule_quiet_on_resolver_and_validation():
+    """The clean twin: sentinel tests inside the module defining
+    resolve_statics (the registered heuristics) and raise-only
+    validation branches are exempt by contract."""
+    assert _fixture("tuning_clean", "tuning-chokepoint") == []
 
 
 # ---------------------------------------------------- baseline machine

@@ -146,7 +146,12 @@ def test_prefetch_config_key(tmp_path):
     assert sim.prefetch_depth == 2 and sim._prefetch == 2
     p.write_text(base)
     auto = AlignedSimulator.from_config(NetworkConfig(str(p)))
-    assert auto.prefetch_depth == -1
+    # since round 14 from_config resolves the -1 auto through the
+    # tuning chokepoint (cache hit or the registered heuristic), so
+    # the built sim carries the CONCRETE schedule (0 under interpret)
+    # plus the resolution record — the -1 never leaks past the seam
+    assert auto.prefetch_depth == auto._prefetch
+    assert auto._tuning.statics["prefetch_depth"] == auto._prefetch
     assert bucket_signature(sim) != bucket_signature(
         AlignedSimulator(topo=sim.topo, n_msgs=sim.n_msgs, mode=sim.mode,
                          churn=sim.churn, pull_window=sim.pull_window,

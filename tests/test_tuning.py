@@ -1,0 +1,441 @@
+"""Round-14 closed-loop autotuner: cache discipline, the resolver
+chokepoint, drift-retune hysteresis, and the hard bitwise contract.
+
+The tuner's contract (ROADMAP item 5 / docs/PERFORMANCE.md "Round
+14"):
+
+* tuned values are statics from the bitwise-identical family ONLY, so
+  a cache-tuned run equals the heuristic-default run bit-for-bit —
+  asserted here across solo / 1-D sharded / 2-D / fleet / serve;
+* a corrupt cache (torn write, CRC mismatch, stale schema) is a NAMED
+  error that falls back to the heuristics, never a crash;
+* the drift gauge's retune trigger is sustained-N with
+  reset-below-and-re-arm — one ``retune_requested`` per excursion, no
+  flapping on a noisy gauge — and a fired trigger marks the signature
+  stale so lookups fall back until the next sweep.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import jax
+
+from p2p_gossipprotocol_tpu.config import ConfigError, NetworkConfig
+from p2p_gossipprotocol_tpu.tuning import cache as tcache
+from p2p_gossipprotocol_tpu.tuning import resolve as tresolve
+
+SIG = tresolve.signature(
+    rows=16, rowblk=16, n_slots=8, n_words=1, mode="pushpull",
+    fanout=0, backend="interpret", n_shards=1, block_perm=False,
+    roll_groups=4, fuse_update=0, pull_window=1)
+
+
+@pytest.fixture
+def cache_file(tmp_path, monkeypatch):
+    path = str(tmp_path / "tuning_cache.json")
+    monkeypatch.setenv(tcache.ENV_CACHE, path)
+    return path
+
+
+def _cfg(text: str) -> NetworkConfig:
+    with tempfile.NamedTemporaryFile("w", suffix=".txt",
+                                     delete=False) as f:
+        f.write("127.0.0.1:8000\nbackend=jax\n" + text)
+        path = f.name
+    try:
+        return NetworkConfig(path)
+    finally:
+        os.unlink(path)
+
+
+def _events(kind):
+    from p2p_gossipprotocol_tpu import telemetry
+
+    return telemetry.recorder().events(kind)
+
+
+# ----------------------------------------------------------- the cache
+def test_cache_roundtrip(cache_file):
+    entry = tcache.store(SIG, {"prefetch_depth": 2},
+                         ms_per_round=1.25, default_ms_per_round=1.5)
+    assert entry["crc32"] == tcache._entry_crc(entry)
+    hit = tcache.lookup(SIG)
+    assert hit is not None
+    assert hit["statics"] == {"prefetch_depth": 2}
+    assert tcache.lookup(SIG[:-1] + (99,)) is None      # other sig
+
+
+def test_cache_disabled_and_missing(cache_file, monkeypatch):
+    assert tcache.lookup(SIG) is None                   # no file yet
+    monkeypatch.setenv(tcache.ENV_CACHE, "off")
+    assert tcache.cache_path() is None
+    assert tcache.lookup(SIG) is None
+    with pytest.raises(tcache.TuningCacheError):
+        tcache.store(SIG, {}, ms_per_round=1, default_ms_per_round=1)
+
+
+def test_cache_torn_write_is_named_error_and_falls_back(cache_file):
+    tcache.store(SIG, {"prefetch_depth": 2}, ms_per_round=1,
+                 default_ms_per_round=1)
+    with open(cache_file) as f:
+        text = f.read()
+    with open(cache_file + ".torn", "w") as f:          # test artifact
+        f.write(text[:len(text) // 2])
+    os.replace(cache_file + ".torn", cache_file)
+    with pytest.raises(tcache.CorruptTuningCache) as ei:
+        tcache.load(cache_file)
+    assert "torn or unreadable" in str(ei.value)
+    n0 = len(_events("tuning_cache_error"))
+    assert tcache.lookup(SIG) is None                   # fallback
+    evs = _events("tuning_cache_error")
+    assert len(evs) == n0 + 1
+    assert evs[-1]["error"] == "CorruptTuningCache"
+
+
+def test_cache_crc_mismatch_names_the_entry(cache_file):
+    tcache.store(SIG, {"prefetch_depth": 2}, ms_per_round=1,
+                 default_ms_per_round=1)
+    with open(cache_file) as f:
+        doc = json.load(f)
+    key = tcache.sig_key(SIG)
+    doc["entries"][key]["statics"]["prefetch_depth"] = 0   # tamper
+    with open(cache_file + ".tmp", "w") as f:           # test artifact
+        json.dump(doc, f)
+    os.replace(cache_file + ".tmp", cache_file)
+    with pytest.raises(tcache.CorruptTuningCache) as ei:
+        tcache.load(cache_file)
+    assert "CRC mismatch" in str(ei.value) and key in str(ei.value)
+    assert tcache.lookup(SIG) is None                   # fallback
+
+
+def test_cache_stale_schema_is_named_error(cache_file):
+    with open(cache_file + ".tmp", "w") as f:           # test artifact
+        json.dump({"schema": tcache.SCHEMA_VERSION + 1,
+                   "entries": {}}, f)
+    os.replace(cache_file + ".tmp", cache_file)
+    with pytest.raises(tcache.StaleTuningSchema):
+        tcache.load(cache_file)
+    assert tcache.lookup(SIG) is None                   # fallback
+
+
+def test_mark_stale_skips_entry_until_retuned(cache_file):
+    tcache.store(SIG, {"prefetch_depth": 2}, ms_per_round=1,
+                 default_ms_per_round=1)
+    assert tcache.lookup(SIG) is not None
+    assert tcache.mark_stale(SIG)
+    assert tcache.lookup(SIG) is None                   # heuristics win
+    assert tcache.stale_signatures() == [tcache.sig_key(SIG)]
+    assert not tcache.mark_stale(SIG)                   # idempotent
+    # a fresh sweep rewrites the entry and it serves again
+    tcache.store(SIG, {"prefetch_depth": 0}, ms_per_round=1,
+                 default_ms_per_round=1)
+    assert tcache.lookup(SIG)["statics"]["prefetch_depth"] == 0
+    assert tcache.stale_signatures() == []
+
+
+# -------------------------------------------------------- the resolver
+def test_resolver_explicit_beats_cache_beats_heuristic(cache_file):
+    tcache.store(SIG, {"prefetch_depth": 2, "frontier_mode": 1},
+                 ms_per_round=1, default_ms_per_round=1)
+    res = tresolve.resolve_statics(
+        SIG,
+        requested={"prefetch_depth": -1, "frontier_mode": 0},
+        heuristics={"prefetch_depth": 0, "frontier_mode": 0})
+    # auto -> cache; explicit 0 -> honored over the cached 1
+    assert res.statics == {"prefetch_depth": 2, "frontier_mode": 0}
+    assert res.source == "cache"
+    assert res.substituted == ("prefetch_depth",)
+    ev = _events("tuned")[-1]
+    assert ev["static"] == "prefetch_depth" and ev["value"] == 2
+
+
+def test_resolver_miss_and_illegal_fall_back(cache_file):
+    res = tresolve.resolve_statics(
+        SIG, requested={"prefetch_depth": -1},
+        heuristics={"prefetch_depth": 0})
+    assert res.statics == {"prefetch_depth": 0}
+    assert res.source == "heuristic" and res.substituted == ()
+    # an illegal cached value is rejected + recorded, never applied
+    tcache.store(SIG, {"prefetch_depth": 7}, ms_per_round=1,
+                 default_ms_per_round=1)
+    res = tresolve.resolve_statics(
+        SIG, requested={"prefetch_depth": -1},
+        heuristics={"prefetch_depth": 0},
+        legal={"prefetch_depth": lambda v: v in (0, 2)})
+    assert res.statics == {"prefetch_depth": 0}
+    assert _events("tuning_rejected")[-1]["static"] == "prefetch_depth"
+
+
+def test_config_accepts_auto_spellings():
+    cfg = _cfg("n_peers=256\nserve_chunk=-1\nfrontier_threshold=-1\n")
+    assert cfg.serve_chunk == -1 and cfg.frontier_threshold == -1.0
+    with pytest.raises(ConfigError):
+        _cfg("n_peers=256\nserve_chunk=0\n")
+    with pytest.raises(ConfigError):
+        _cfg("n_peers=256\nfrontier_threshold=0\n")
+    with pytest.raises(ConfigError):
+        _cfg("n_peers=256\nfrontier_threshold=1.5\n")
+
+
+# ---------------------------------------------- the bitwise contract
+_STATE_LEAVES = ("seen_w", "frontier_w", "alive_b", "byz_w", "key",
+                 "round")
+_METRICS = ("coverage", "deliveries", "frontier_size", "live_peers",
+            "evictions")
+
+
+def _assert_bitwise(a, b):
+    for k in _STATE_LEAVES:
+        assert np.array_equal(
+            np.asarray(jax.device_get(getattr(a.state, k))),
+            np.asarray(jax.device_get(getattr(b.state, k)))), k
+    for k in _METRICS:
+        assert np.array_equal(np.asarray(getattr(a, k)),
+                              np.asarray(getattr(b, k))), k
+
+
+def _build_pair(cfg_text, tuned_statics, monkeypatch, cache_file,
+                n_peers=None):
+    """(default_sim, tuned_sim): same config built with the cache off
+    vs. holding ``tuned_statics`` for the build's own signature."""
+    from p2p_gossipprotocol_tpu.engines import build_simulator
+
+    cfg = _cfg(cfg_text)
+    monkeypatch.setenv(tcache.ENV_CACHE, "off")
+    sim_d, name_d = build_simulator(cfg, n_peers=n_peers)
+    assert sim_d._tuning.source == "heuristic"
+    tcache.store(sim_d._tuning.signature, tuned_statics,
+                 ms_per_round=1, default_ms_per_round=2,
+                 path=cache_file)
+    monkeypatch.setenv(tcache.ENV_CACHE, cache_file)
+    sim_t, name_t = build_simulator(cfg, n_peers=n_peers)
+    assert name_t == name_d
+    assert sim_t._tuning.source == "cache"
+    assert sim_t._tuning.substituted, "cache should substitute here"
+    return sim_d, sim_t
+
+
+TUNED = {"frontier_mode": 1, "prefetch_depth": 2,
+         "frontier_threshold": 1.0 / 32, "overlap_mode": 1,
+         "hier_mode": 0}
+
+
+def test_tuned_bitwise_solo(cache_file, monkeypatch):
+    sim_d, sim_t = _build_pair(
+        "engine=aligned\nn_peers=1024\nn_messages=16\navg_degree=8\n"
+        "mode=pushpull\nchurn_rate=0.02\n", TUNED, monkeypatch,
+        cache_file)
+    assert sim_t._prefetch == 2 and sim_t._frontier_skip
+    _assert_bitwise(sim_d.run(5), sim_t.run(5))
+
+
+@pytest.mark.slow   # broadest VARIANT (tier-1 budget, the PR-5 rule):
+# the sharded build-pair composes the solo sibling (tier-1) with the
+# lifted-statics seam test_fleet/test_overlap already exercise; runs
+# standalone / full suite
+def test_tuned_bitwise_sharded_1d(cache_file, monkeypatch, devices8):
+    sim_d, sim_t = _build_pair(
+        "engine=aligned\nn_peers=2048\nn_messages=160\navg_degree=8\n"
+        "mode=pushpull\nmesh_devices=2\n", TUNED, monkeypatch,
+        cache_file)
+    # overlap + frontier + prefetch all substituted on the wide-W
+    # block-perm overlay
+    assert set(sim_t._tuning.substituted) >= {
+        "frontier_mode", "prefetch_depth", "overlap_mode"}
+    _assert_bitwise(sim_d.run(4), sim_t.run(4))
+
+
+@pytest.mark.slow   # broadest VARIANT (tier-1 budget, the PR-5 rule):
+# the 2-D mesh composes the same lifted statics the 1-D sibling above
+# keeps in tier-1; runs standalone / full suite
+def test_tuned_bitwise_2d(cache_file, monkeypatch, devices8):
+    sim_d, sim_t = _build_pair(
+        "engine=aligned\nn_peers=4096\nn_messages=256\navg_degree=8\n"
+        "mode=pushpull\nmesh_devices=4\nmsg_shards=2\n", TUNED,
+        monkeypatch, cache_file)
+    _assert_bitwise(sim_d.run(6), sim_t.run(6))
+
+
+def _fleet_pair(cfg, specs, monkeypatch, cache_file):
+    from p2p_gossipprotocol_tpu.fleet.spec import build_scenarios
+
+    monkeypatch.setenv(tcache.ENV_CACHE, "off")
+    scen_d = build_scenarios(cfg, specs)
+    tcache.store(scen_d[0].sim._tuning.signature,
+                 {"frontier_mode": 1, "prefetch_depth": 2},
+                 ms_per_round=1, default_ms_per_round=2,
+                 path=cache_file)
+    monkeypatch.setenv(tcache.ENV_CACHE, cache_file)
+    scen_t = build_scenarios(cfg, specs)
+    return scen_d, scen_t
+
+
+def test_fleet_tuned_packing_and_provenance(cache_file, monkeypatch):
+    """Cache-tuned scenario sims still pack into ONE bucket (the
+    substituted statics flow into the resolved fields the packer
+    signatures) and the results row carries the provenance."""
+    from p2p_gossipprotocol_tpu.fleet.packer import bucket_signature
+
+    cfg = _cfg("engine=aligned\nn_peers=1024\nn_messages=16\n"
+               "avg_degree=8\nmode=pushpull\n")
+    scen_d, scen_t = _fleet_pair(
+        cfg, [{"prng_seed": 1}, {"prng_seed": 2}], monkeypatch,
+        cache_file)
+    for s in scen_t:
+        assert s.sim._tuning.source == "cache"
+    assert len({bucket_signature(s.sim) for s in scen_t}) == 1
+    # tuned and default schedules are DIFFERENT programs — they must
+    # never share a bucket
+    assert bucket_signature(scen_t[0].sim) != \
+        bucket_signature(scen_d[0].sim)
+    assert scen_t[0].row_identity()["tuned_from"] == "cache"
+    assert "frontier_mode" in scen_t[0].row_identity()["tuned"]
+
+
+@pytest.mark.slow   # broadest VARIANT (tier-1 budget): the bucket-run
+# parity composes the packing test above (tier-1) with the bitwise
+# contract the solo/1-D tests keep in tier-1; runs standalone
+def test_tuned_bitwise_fleet(cache_file, monkeypatch):
+    """A fleet bucket of cache-tuned scenario sims serves the exact
+    trajectories of the default-built bucket."""
+    from p2p_gossipprotocol_tpu.fleet import FleetBucket
+
+    cfg = _cfg("engine=aligned\nn_peers=1024\nn_messages=16\n"
+               "avg_degree=8\nmode=pushpull\n")
+    scen_d, scen_t = _fleet_pair(
+        cfg, [{"prng_seed": 1}, {"prng_seed": 2}], monkeypatch,
+        cache_file)
+    rd = FleetBucket([s.sim for s in scen_d]).run(8, target=0.99)
+    rt = FleetBucket([s.sim for s in scen_t]).run(8, target=0.99)
+    assert np.array_equal(np.asarray(rd.rounds_run),
+                          np.asarray(rt.rounds_run))
+    for res_d, res_t in zip(rd.results, rt.results):
+        _assert_bitwise(res_d, res_t)
+
+
+def test_serve_chunk_resolves_through_chokepoint(cache_file,
+                                                 monkeypatch):
+    """cfg serve_chunk=-1 (the default) resolves to the classic 8 on a
+    cache miss and to the cached cadence on a hit; an explicit chunk
+    is honored; a served scenario's result is bitwise its solo run
+    under the tuned cadence (the fleet/serve contract at any chunk)."""
+    from p2p_gossipprotocol_tpu.serve.service import GossipService
+
+    cfg = _cfg("engine=aligned\nn_peers=512\nn_messages=8\n"
+               "avg_degree=8\nrounds=24\nserve_slots=2\n")
+    assert cfg.serve_chunk == -1
+    monkeypatch.setenv(tcache.ENV_CACHE, "off")
+    svc = GossipService(cfg)
+    assert (svc.chunk, svc.chunk_source) == (
+        tresolve.SERVE_CHUNK_DEFAULT, "heuristic")
+    tcache.store(tresolve.serve_signature(svc.slots, svc.rounds),
+                 {"serve_chunk": 3}, ms_per_round=1,
+                 default_ms_per_round=2, path=cache_file)
+    monkeypatch.setenv(tcache.ENV_CACHE, cache_file)
+    svc_t = GossipService(cfg)
+    assert (svc_t.chunk, svc_t.chunk_source) == (3, "cache")
+    assert GossipService(cfg, chunk=5).chunk == 5       # explicit wins
+    # tuned-cadence serve == solo, bitwise
+    svc_t.start()
+    rid = svc_t.submit({"prng_seed": 7})
+    row = svc_t.result(rid, timeout=120)
+    req = svc_t.scheduler.requests[rid]
+    served = req.result
+    solo = req.spec.sim.run(row["rounds_run"])
+    _assert_bitwise(served, solo)
+    svc_t.drain()
+
+
+# ------------------------------------------------- drift hysteresis
+class _Rec:
+    """Minimal recorder stand-in: capture events/counters."""
+
+    def __init__(self):
+        self.events = []
+        self.counters = {}
+
+    def event(self, kind, **fields):
+        self.events.append({"kind": kind, **fields})
+
+    def counter_add(self, name, value=1.0):
+        self.counters[name] = self.counters.get(name, 0) + value
+
+
+def _tracker(sig=None):
+    from p2p_gossipprotocol_tpu.telemetry.roofline import \
+        RooflineTracker
+
+    return RooflineTracker(lambda fill=None: {"total": 100.0},
+                           dense_bytes_round=100.0, n_peers=1000,
+                           tuning_sig=sig)
+
+
+def test_drift_fires_once_after_sustained_n():
+    tr, rec = _tracker(), _Rec()
+    for _ in range(tr.DRIFT_RETUNE_SUSTAIN - 1):
+        tr._check_drift(0.5, rec)
+    assert rec.events == []                       # not sustained yet
+    tr._check_drift(0.5, rec)
+    assert [e["kind"] for e in rec.events] == ["retune_requested"]
+    assert rec.events[0]["sustained_chunks"] == tr.DRIFT_RETUNE_SUSTAIN
+    for _ in range(10):                           # stays high: no flap
+        tr._check_drift(0.6, rec)
+    assert len(rec.events) == 1
+
+
+def test_drift_noisy_gauge_never_fires():
+    tr, rec = _tracker(), _Rec()
+    for i in range(40):                           # oscillates around thr
+        tr._check_drift(0.5 if i % 2 else 0.1, rec)
+    assert rec.events == []
+
+
+def test_drift_rearms_below_threshold_then_fires_again():
+    tr, rec = _tracker(), _Rec()
+    for _ in range(tr.DRIFT_RETUNE_SUSTAIN):
+        tr._check_drift(0.5, rec)
+    tr._check_drift(0.1, rec)                     # recovery: re-arm
+    for _ in range(tr.DRIFT_RETUNE_SUSTAIN):
+        tr._check_drift(0.5, rec)
+    assert len(rec.events) == 2                   # one per excursion
+
+
+def test_drift_marks_signature_stale(cache_file):
+    tcache.store(SIG, {"prefetch_depth": 2}, ms_per_round=1,
+                 default_ms_per_round=1)
+    tr, rec = _tracker(sig=SIG), _Rec()
+    for _ in range(tr.DRIFT_RETUNE_SUSTAIN):
+        tr._check_drift(0.9, rec)
+    assert rec.events[-1]["stale_marked"] is True
+    assert rec.events[-1]["signature"] == tcache.sig_key(SIG)
+    assert tcache.lookup(SIG) is None             # heuristics serve now
+    assert rec.counters.get("retune_requested_total") == 1
+
+
+def test_drift_end_to_end_through_update(cache_file):
+    """The integration plumbing: update() computes the cumulative
+    drift gauge and routes it into the hysteresis (telemetry on)."""
+    from p2p_gossipprotocol_tpu import telemetry
+
+    rec = telemetry.recorder()
+    prev = rec.enabled
+    rec.configure(enabled=True)
+    try:
+        n0 = len(rec.events("retune_requested"))
+        tr = _tracker(sig=SIG)
+        tr._model_fn = lambda fill=None: {
+            "total": 100.0 if fill is None else max(1.0, 100.0 * fill)}
+        for _ in range(tr.DRIFT_RETUNE_SUSTAIN):
+            tr.update(1, 0.001,
+                      {"frontier_size": np.asarray([10])})
+        evs = rec.events("retune_requested")
+        assert len(evs) == n0 + 1
+        assert evs[-1]["drift"] > tr.DRIFT_RETUNE_THRESHOLD
+    finally:
+        rec.configure(enabled=prev)
