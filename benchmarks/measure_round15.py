@@ -1,0 +1,278 @@
+"""Round-15 chaos + SLO rows: the fault-tolerant serving fleet.
+
+Three measurement families (ISSUE 13 acceptance):
+
+* ``fleet_chaos_sigkill`` / ``fleet_chaos_sigstop``: a 3-replica fleet
+  (serve/router.py) under seeded Poisson offered load; at mid-load the
+  harness SIGKILLs (or SIGSTOPs — the hung/wedged case the heartbeat
+  staleness deadline catches) the replica carrying the most in-flight
+  requests.  Each row records:
+
+  - ``detect_s``  — kill instant -> the router's recorded death stamp
+    (SIGKILL must be sub-second; SIGSTOP lands at ~``serve_health_s``);
+  - ``mttr_s``    — detect -> last recovered request re-admitted
+    (adopted-from-salvage rows included);
+  - ``lost`` / ``dup`` — MUST both be 0: every accepted request
+    completes exactly once (router rids are the dedup key);
+  - ``parity_ok`` — every redirected row plus a first/last probe
+    compared against its solo run at the same round count
+    (final_coverage float-bitwise + total_deliveries + rounds_run;
+    the full-leaf bitwise compare lives in tests/test_serve.py — the
+    fleet adds a process hop, not a new execution engine).
+
+* ``slo_overload``: the SAME burst at equal capacity served twice by a
+  single server — FIFO baseline vs deadline-aware admission (EDF
+  ordering + typed shedding).  Acceptance: p50/p99 of COMPLETED
+  requests no worse than the PR 9 baseline (``slo_ok``), with the shed
+  taxonomy counts on the row (doomed work is refused, not executed).
+
+Run on the chip (watchdog chain step measure_round15):
+    PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/measure_round15.py
+Appends one JSON row per measurement to GOSSIP_R15_OUT (default
+benchmarks/results/round15_tpu.jsonl on TPU, round15_cpu.jsonl
+elsewhere), resuming per-config like the round-12 driver.  Knobs:
+GOSSIP_R15_PEERS (4096), GOSSIP_R15_N (15), GOSSIP_R15_RATE (6),
+GOSSIP_R15_SEED (0), GOSSIP_R15_OVERLOAD_N (24).
+"""
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+OUT = None          # set in main() once the platform is known
+
+
+def emit(row):
+    row["device"] = str(jax.devices()[0]).replace(" ", "_")
+    row["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    print(json.dumps(row), flush=True)
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def _landed() -> set:
+    from benchmarks._common import landed
+    return landed(OUT)
+
+
+def _cfg_file(n: int, rounds: int, run_dir: str, extra: str = "") -> str:
+    from p2p_gossipprotocol_tpu.utils.logging import write_atomic
+
+    # the file must OUTLIVE this function: replica subprocesses
+    # re-parse it at launch
+    path = os.path.join(run_dir, "fleet_network.txt")
+    write_atomic(path,
+                 f"127.0.0.1:8000\nbackend=jax\nn_peers={n}\n"
+                 f"n_messages=16\navg_degree=8\nrounds={rounds}\n"
+                 f"serve_chunk=2\nserve_target=0.999\n{extra}")
+    return path
+
+
+def _specs(n_req: int) -> list[dict]:
+    """Three signature families, so recovery always has same- and
+    cross-family survivors to land on."""
+    specs = []
+    for s in range(n_req):
+        ov = {"prng_seed": s}
+        if s % 3 == 1:
+            ov["mode"] = "pull"
+        if s % 3 == 2:
+            ov["mode"] = "pushpull"
+        specs.append(ov)
+    return specs
+
+
+def _row_parity(cfg, specs, rows, probe) -> bool:
+    from p2p_gossipprotocol_tpu.fleet import build_scenarios
+
+    ok = True
+    for i in sorted(probe):
+        row = rows[i]
+        ov = {k: v for k, v in specs[i].items()
+              if k not in ("deadline_ms", "priority")}
+        solo = build_scenarios(cfg, [ov])[0].sim.run(row["rounds_run"])
+        ok = ok and (float(solo.coverage[-1]) == row["final_coverage"]
+                     and int(round(float(solo.deliveries.sum())))
+                     == row["total_deliveries"])
+    return ok
+
+
+def bench_fleet_chaos(kind: str, n: int, n_req: int, rate: float,
+                      seed: int, done):
+    tag = f"fleet_chaos_{kind}"
+    if tag in done:
+        return
+    import random
+
+    from p2p_gossipprotocol_tpu.config import NetworkConfig
+    from p2p_gossipprotocol_tpu.serve.router import (INFLIGHT,
+                                                     RouterService)
+
+    run_dir = tempfile.mkdtemp(prefix=f"gossip_r15_{kind}_")
+    cfg = NetworkConfig(_cfg_file(n, rounds=64, run_dir=run_dir))
+    rng = random.Random(seed)
+    gaps = [rng.expovariate(rate) for _ in range(n_req)]
+    specs = _specs(n_req)
+    svc = RouterService(cfg, replicas=3, run_dir=run_dir)
+    try:
+        svc.start()
+        svc.wait_ready(timeout=300)
+        t0 = time.perf_counter()
+        rids = []
+        killed = None
+        t_kill = None
+        for i, (ov, gap) in enumerate(zip(specs, gaps)):
+            time.sleep(gap)
+            rids.append(svc.submit(ov))
+            if killed is None and i == n_req // 2:
+                # the chaos moment: hit the replica carrying the most
+                # in-flight work (seed-deterministic — the ledger is)
+                with svc._lock:
+                    load = {}
+                    for r in svc._requests.values():
+                        if r.status == INFLIGHT \
+                                and r.replica is not None:
+                            load[r.replica] = load.get(r.replica, 0) + 1
+                    victim = (max(load, key=load.get) if load else 0)
+                    pid = svc._replicas[victim].proc.pid
+                sig = (signal.SIGKILL if kind == "sigkill"
+                       else signal.SIGSTOP)
+                t_kill = time.time()
+                os.killpg(pid, sig)
+                killed = victim
+        rows = [svc.result(r, timeout=600) for r in rids]
+        wall = time.perf_counter() - t0
+        st = svc.drain(timeout=300)
+        lost = n_req - st["done"]
+        ids = [r["request"] for r in rows]
+        dup = len(ids) - len(set(ids))
+        detect_s = (st.get("last_death_ts") or t_kill) - t_kill
+        probe = {0, n_req - 1} | {i for i, r in enumerate(rows)
+                                  if r.get("redirects")}
+        parity = _row_parity(cfg, specs, rows, probe)
+        emit({"config": tag, "n_peers": n, "n": n_req,
+              "rate_rps": rate, "seed": seed, "replicas": 3,
+              "victim": killed,
+              "detect_s": round(detect_s, 3),
+              "mttr_s": st.get("mttr_s"),
+              "lost": lost, "dup": dup,
+              "redirects": st.get("redirects", 0),
+              "adopted": st.get("adopted", 0),
+              "restarts": st.get("restarts", 0),
+              "wall_s": round(wall, 3),
+              "parity_ok": parity,
+              "chaos_ok": (lost == 0 and dup == 0 and parity
+                           and st.get("mttr_s") is not None
+                           and (detect_s < 1.0 if kind == "sigkill"
+                                else detect_s < cfg.serve_health_s
+                                + 1.0))})
+    finally:
+        svc.stop()
+
+
+def bench_slo_overload(n: int, n_req: int, done):
+    """Deadline-aware admission vs the PR 9 FIFO baseline at equal
+    capacity, under a burst past saturation.  Capacity is deliberately
+    QUEUE-bound (one signature family, 2 slots): shedding acts at
+    admission boundaries, so the A/B must make the queue — not the
+    device — the bottleneck, exactly the overload regime the ROADMAP's
+    round-12 hockey-stick identified."""
+    tag = "slo_overload"
+    if tag in done:
+        return
+    from p2p_gossipprotocol_tpu.config import NetworkConfig
+    from p2p_gossipprotocol_tpu.serve import GossipService, ServeShed
+
+    run_dir = tempfile.mkdtemp(prefix="gossip_r15_slo_")
+    cfg = NetworkConfig(_cfg_file(n, rounds=64, run_dir=run_dir))
+    specs = [{"prng_seed": s} for s in range(n_req)]   # ONE family
+
+    def _burst(slo: bool, tight_ms: float = 0.0, loose_ms: float = 0.0):
+        svc = GossipService(cfg, slots=2, queue_max=n_req,
+                            max_buckets=1, target=0.999,
+                            rounds=64).start()
+        rids = []
+        t0 = time.perf_counter()
+        for i, ov in enumerate(specs):
+            line = dict(ov)
+            if slo:
+                # half the burst is latency-tolerant, half carries a
+                # budget the overloaded queue cannot honor for all
+                line["deadline_ms"] = (loose_ms if i % 2 == 0
+                                       else tight_ms)
+            rids.append(svc.submit(line))
+        shed = 0
+        for r in rids:
+            try:
+                svc.result(r, timeout=600)
+            except ServeShed:
+                shed += 1
+        wall = time.perf_counter() - t0
+        st = svc.stats()
+        svc.drain()
+        return {"p50_ms": st.get("p50_ms"), "p99_ms": st.get("p99_ms"),
+                "wall_s": round(wall, 3), "shed": shed,
+                "shed_reasons": st.get("shed_reasons", {})}
+
+    # warm the jit cache OUTSIDE both bursts — the baseline must not
+    # be the run that pays compilation, or the A/B measures the cache
+    warm = GossipService(cfg, slots=2, queue_max=4, max_buckets=1,
+                         target=0.999, rounds=64).start()
+    warm.result(warm.submit({"prng_seed": 0}), timeout=600)
+    warm.drain()
+    base = _burst(slo=False)
+    # the tight budget is calibrated FROM the measured overload (a
+    # third of the baseline median wait): honored for the front of the
+    # EDF queue, impossible for its tail — the shed regime by
+    # construction, at any machine speed
+    tight_ms = max(150.0, base["p50_ms"] / 3)
+    slo = _burst(slo=True, tight_ms=tight_ms,
+                 loose_ms=base["p99_ms"] * 20)
+    # completed-population latency must not regress vs FIFO-serve-all,
+    # and the overload must actually have shed something (otherwise
+    # the row measured an idle queue, not admission policy)
+    slo_ok = (slo["shed"] > 0
+              and slo["p50_ms"] <= base["p50_ms"] * 1.05
+              and slo["p99_ms"] <= base["p99_ms"] * 1.05)
+    emit({"config": tag, "n_peers": n, "n": n_req, "slots": 2,
+          "tight_deadline_ms": round(tight_ms, 1),
+          "base_p50_ms": base["p50_ms"], "base_p99_ms": base["p99_ms"],
+          "base_wall_s": base["wall_s"],
+          "slo_p50_ms": slo["p50_ms"], "slo_p99_ms": slo["p99_ms"],
+          "slo_wall_s": slo["wall_s"],
+          "shed": slo["shed"], "shed_reasons": slo["shed_reasons"],
+          "slo_ok": slo_ok})
+
+
+def main():
+    global OUT
+    backend = jax.default_backend()
+    on_tpu = backend in ("tpu", "axon")
+    default = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "results",
+        "round15_cpu.jsonl" if not on_tpu else "round15_tpu.jsonl")
+    OUT = os.environ.get("GOSSIP_R15_OUT", default)
+    n = int(os.environ.get("GOSSIP_R15_PEERS", "4096"))
+    n_req = int(os.environ.get("GOSSIP_R15_N", "15"))
+    rate = float(os.environ.get("GOSSIP_R15_RATE", "6"))
+    seed = int(os.environ.get("GOSSIP_R15_SEED", "0"))
+    overload_n = int(os.environ.get("GOSSIP_R15_OVERLOAD_N", "24"))
+    done = _landed()
+    if "_backend" not in done:
+        emit({"config": "_backend", "backend": backend, "n_peers": n})
+    bench_fleet_chaos("sigkill", n, n_req, rate, seed, done)
+    bench_fleet_chaos("sigstop", n, n_req, rate, seed, done)
+    bench_slo_overload(n, overload_n, done)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

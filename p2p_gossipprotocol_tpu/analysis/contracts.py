@@ -82,6 +82,14 @@ FINGERPRINT_ATTR_ALIASES = {
 # Underscore attributes AlignedSimulator resolves are statics by
 # convention; each must appear in bucket_signature or be listed here
 # with why it cannot change the single-device compiled program.
+#
+# Consumers of the signature beyond the packer: the serve scheduler's
+# bucket routing (PR 9) and the fleet router's replica affinity
+# (PR 13, serve/router.py — tests/test_serve_fleet.py pins that the
+# router's cached signature IS bucket_signature, so a static this rule
+# forces into the signature automatically re-routes across replicas
+# too; a ghost static would break BOTH tiers, which is why the rule's
+# scope stays the simulator, not each consumer).
 
 PACKER_EXEMPT = {
     "_frontier_delta": (

@@ -87,6 +87,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "exits 75; --serve --resume re-hydrates them. "
                         "Config twins: serve=1 and the serve_* keys "
                         "(docs/ARCHITECTURE.md \"The serving seam\")")
+    p.add_argument("--serve-fleet", action="store_true",
+                   help="jax mode: run the FAULT-TOLERANT serving "
+                        "fleet (serve/router.py): serve_replicas "
+                        "supervised --serve replica processes behind "
+                        "a signature-affinity router on "
+                        "local_ip:local_port.  Clients speak the "
+                        "unchanged submit/result/stats/drain "
+                        "protocol; same-signature requests stick to "
+                        "one replica (zero-recompile admission "
+                        "survives the hop); a SIGKILLed replica's "
+                        "in-flight requests re-admit onto survivors — "
+                        "zero lost, zero duplicated, every result "
+                        "still bitwise its solo run "
+                        "(docs/ROBUSTNESS.md \"The serving fleet\")")
+    p.add_argument("--serve-heartbeat", default=None, metavar="PATH",
+                   help="serve-replica mode (set by the fleet "
+                        "router): stamp the supervision plane's "
+                        "heartbeat file at PATH sub-second, carrying "
+                        "the BOUND serve port (an EADDRINUSE rebind "
+                        "is discovered through it), and refresh the "
+                        "salvage checkpoint periodically so a SIGKILL "
+                        "leaves a recent manifest to recover from")
+    p.add_argument("--serve-rank", type=int, default=0, metavar="R",
+                   help="serve-replica mode: this replica's rank in "
+                        "the fleet (stamped into the heartbeat)")
     p.add_argument("--mesh-devices", type=int, default=None, metavar="N",
                    help="jax mode: shard the peer axis over an N-device "
                         "mesh (ShardedSimulator / "
@@ -383,13 +408,24 @@ def _run_serve(cfg: NetworkConfig, args) -> int:
             rounds=args.rounds or None,
             checkpoint_dir=args.checkpoint_dir,
             results_path=args.sweep_results or None,
-            resume=args.resume, log=log)
+            resume=args.resume,
+            # replica mode (the fleet router launched us): refresh the
+            # salvage snapshot continuously — a SIGKILL runs no
+            # handler, so the router recovers from the last periodic
+            # manifest instead of losing completed work
+            persist_every_s=(1.0 if args.serve_heartbeat
+                             and args.checkpoint_dir else 0.0),
+            log=log)
     except (CheckpointError, ValueError) as e:
         print(f"Error: {e}", file=sys.stderr)
         return 1
     server = ServeServer(service, cfg.get_local_ip(),
                          cfg.get_local_port(),
                          wire_format=cfg.wire_format, log=log)
+    on_bound = None
+    if args.serve_heartbeat:
+        on_bound = (lambda port: service.configure_heartbeat(
+            args.serve_heartbeat, port, rank=args.serve_rank))
     stop = {"salvage": False}
 
     def handler(signum, frame):
@@ -408,16 +444,18 @@ def _run_serve(cfg: NetworkConfig, args) -> int:
     signal.signal(signal.SIGINT, handler)
     signal.signal(signal.SIGTERM, handler)
     try:
-        server.start()
+        server.start(on_bound=on_bound)
     except OSError as e:
         print(f"Error: cannot bind {cfg.get_local_ip()}:"
               f"{cfg.get_local_port()} ({e})", file=sys.stderr)
         return 1
     if not args.quiet:
+        rebound = (f" (rebound from {server.rebound_from})"
+                   if server.rebound_from else "")
         print(f"[jax/serve] resident server on {cfg.get_local_ip()}:"
-              f"{cfg.get_local_port()} — {service.slots} slots/bucket, "
-              f"<= {service.max_buckets} buckets, queue <= "
-              f"{service.scheduler.queue_max}, target "
+              f"{server.port}{rebound} — {service.slots} "
+              f"slots/bucket, <= {service.max_buckets} buckets, "
+              f"queue <= {service.scheduler.queue_max}, target "
               f"{service.target:g}, chunk {service.chunk}")
     server.wait()
     server.stop()
@@ -428,6 +466,71 @@ def _run_serve(cfg: NetworkConfig, args) -> int:
         return EX_RESUMABLE
     stats = service.drain()
     print(json.dumps({"engine": "serve", **stats}))
+    return 0
+
+
+def _run_serve_fleet(cfg: NetworkConfig, args) -> int:
+    """Run the fault-tolerant serving fleet (serve/router.py):
+    ``serve_replicas`` supervised ``--serve`` replica children behind
+    the signature-affinity router, fronted by the SAME wire protocol
+    on local_ip:local_port.  SIGINT/SIGTERM drain the router
+    gracefully (replicas own their per-process salvage)."""
+    from p2p_gossipprotocol_tpu.serve.router import RouterService
+    from p2p_gossipprotocol_tpu.serve.server import ServeServer
+
+    log = None if args.quiet else (
+        lambda msg: print(msg, file=sys.stderr))
+    try:
+        service = RouterService(cfg, n_peers=args.n_peers,
+                                run_dir=args.checkpoint_dir or None,
+                                log=log)
+    except ValueError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    server = ServeServer(service, cfg.get_local_ip(),
+                         cfg.get_local_port(),
+                         wire_format=cfg.wire_format, log=log)
+
+    def handler(signum, frame):
+        print("\nReceived signal to terminate — draining the fleet "
+              "(in-flight work finishes on the replicas before exit).",
+              file=sys.stderr)
+        server._stop.set()
+
+    signal.signal(signal.SIGINT, handler)
+    signal.signal(signal.SIGTERM, handler)
+    # form the fleet BEFORE opening the wire: a client must never see
+    # a bound port whose submits bounce off a still-forming fleet
+    # (RouterService.start() is idempotent — ServeServer re-invoking
+    # it is a no-op)
+    try:
+        service.start()
+        service.wait_ready(timeout=300)
+    except TimeoutError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        service.stop()
+        return 1
+    try:
+        server.start()
+    except OSError as e:
+        print(f"Error: cannot bind {cfg.get_local_ip()}:"
+              f"{cfg.get_local_port()} ({e})", file=sys.stderr)
+        service.stop()
+        return 1
+    if not args.quiet:
+        rebound = (f" (rebound from {server.rebound_from})"
+                   if server.rebound_from else "")
+        print(f"[jax/serve-fleet] router on {cfg.get_local_ip()}:"
+              f"{server.port}{rebound} — {service.n_replicas} "
+              f"replicas, health deadline {service.health_s:g}s, "
+              f"run dir {service.run_dir}")
+    try:
+        server.wait()
+    finally:
+        server.stop()
+        stats = service.drain(timeout=600)
+        service.stop()
+    print(json.dumps({"engine": "serve-fleet", **stats}))
     return 0
 
 
@@ -677,20 +780,23 @@ def main(argv: list[str] | None = None) -> int:
               file=sys.stderr)
         return 1
 
-    if args.serve or cfg.serve:
-        # resident server: the process stays up serving submissions;
-        # the one-shot simulation path below never runs
+    if args.serve_fleet or args.serve or cfg.serve:
+        # resident server (or the replicated fleet): the process stays
+        # up serving submissions; the one-shot path below never runs
+        what = "--serve-fleet" if args.serve_fleet else "--serve"
         if cfg.backend != "jax":
-            print("Error: --serve is a jax-backend feature (the "
+            print(f"Error: {what} is a jax-backend feature (the "
                   "socket runtime is one real peer process; the serve "
                   "protocol shares its wire, not its role)",
                   file=sys.stderr)
             return 1
         if cfg.mode == "sir":
-            print("Error: --serve serves the gossip modes (the fleet "
+            print(f"Error: {what} serves the gossip modes (the fleet "
                   "engine batches push/pull/pushpull scenarios)",
                   file=sys.stderr)
             return 1
+        if args.serve_fleet:
+            return _run_serve_fleet(cfg, args)
         return _run_serve(cfg, args)
 
     if args.supervise or cfg.supervise:

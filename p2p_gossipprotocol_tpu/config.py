@@ -207,6 +207,13 @@ _SIM_INT_KEYS = {
     "serve_max_buckets": "serve_max_buckets",
     "serve_chunk": "serve_chunk",
     "serve_rounds": "serve_rounds",
+    # Serving fleet (serve/router.py; CLI --serve-fleet): replica
+    # count behind the signature-affinity router, and whether
+    # deadline-expired requests are SHED (typed reasons, never
+    # executed) or only ordered (serve_deadline_shed=0 keeps the
+    # earliest-deadline-first queue but executes everything).
+    "serve_replicas": "serve_replicas",
+    "serve_deadline_shed": "serve_deadline_shed",
     # Self-healing multi-process runs (runtime/supervisor.py; jax
     # backend, engine=aligned): supervise=1 launches the run as
     # supervise_workers worker processes under the health plane —
@@ -252,6 +259,15 @@ _SIM_FLOAT_KEYS = {
     # scenario (frees its slot); must be in (0, 1) — a server without
     # a retirement rule would hold slots forever.
     "serve_target": "serve_target",
+    # SLO admission (serve/scheduler.py): the default admission-to-
+    # result budget (ms) stamped on requests that carry no
+    # deadline_ms of their own (0 = no default — only requests that
+    # ask for a deadline get one).
+    "serve_deadline_ms": "serve_deadline_ms",
+    # Serving fleet (serve/router.py): seconds of heartbeat staleness
+    # after which the router declares a replica hung (the
+    # SIGSTOP/wedge case; process death is caught in ~one poll).
+    "serve_health_s": "serve_health_s",
     # aligned engine: frontier-sparse delta-exchange capacity as a
     # fraction of each shard's packed words — the sparse regime engages
     # when every shard's changed-word count fits (with hysteresis;
@@ -427,6 +443,11 @@ class NetworkConfig:
         self.serve_rounds = 0            # per-scenario cap; 0 = rounds/64
         self.serve_target = 0.99         # retirement coverage target
         self.serve_results = ""          # served-rows JSONL (append)
+        # Serving fleet (serve/router.py; --serve-fleet) + SLO admission
+        self.serve_replicas = 3          # replicas behind the router
+        self.serve_deadline_ms = 0.0     # default request deadline; 0=off
+        self.serve_deadline_shed = 1     # shed expired requests (typed)
+        self.serve_health_s = 1.0        # heartbeat-staleness deadline
         # Telemetry plane (telemetry/; docs/OBSERVABILITY.md)
         self.telemetry = 0               # 1 = spans+counters+roofline on
         self.telemetry_ring = 4096       # flight-recorder ring bound
@@ -576,6 +597,23 @@ class NetworkConfig:
             raise ConfigError(
                 "serve_target must be in (0, 1) — a served scenario "
                 "retires (frees its slot) at this coverage")
+        if self.serve_replicas < 1:
+            raise ConfigError(
+                "serve_replicas must be >= 1 (the fleet router needs "
+                "at least one replica to route to)")
+        if self.serve_deadline_ms < 0:
+            raise ConfigError(
+                "serve_deadline_ms must be >= 0 (0 = no default "
+                "deadline; per-request deadline_ms fields still apply)")
+        if self.serve_deadline_shed not in (0, 1):
+            raise ConfigError(
+                "serve_deadline_shed must be 0 (order only) or 1 "
+                "(shed expired requests with a typed reason)")
+        if self.serve_health_s <= 0:
+            raise ConfigError(
+                "serve_health_s must be > 0 — the router needs a "
+                "finite heartbeat-staleness deadline to detect a hung "
+                "replica")
         if self.supervise:
             if self.supervise_workers < 1 \
                     or self.supervise_devs_per_proc < 1:

@@ -52,10 +52,15 @@ _RESERVED = {"engine", "mesh_devices", "msg_shards", "sweep_file",
              "checkpoint_resume", "backend", "local_ip", "local_port",
              # serving plane: how the SERVER runs, never what one
              # scenario simulates (serve/scheduler.py resolves request
-             # dicts through this same table)
+             # dicts through this same table; the per-REQUEST SLO
+             # fields deadline_ms/priority are stripped before
+             # resolution — scheduler.SLO_KEYS — so they never land
+             # here)
              "serve", "serve_slots", "serve_queue_max",
              "serve_max_buckets", "serve_chunk", "serve_rounds",
-             "serve_target", "serve_results",
+             "serve_target", "serve_results", "serve_replicas",
+             "serve_deadline_ms", "serve_deadline_shed",
+             "serve_health_s",
              # telemetry watches the PROCESS, never one scenario
              "telemetry", "telemetry_ring", "telemetry_dump_dir"}
 

@@ -77,6 +77,17 @@ CPU_MULTIPROCESS_ERR = "Multiprocess computations aren"
 
 HB_PHASES = ("launch", "init", "hold", "run", "done")
 
+#: heartbeat ``kind`` a serving-fleet replica stamps (serve/router.py
+#: reads it): the serve-replica child is the supervision plane's second
+#: child kind — same heartbeat-file contract, same exit-code
+#: classification (:func:`classify_exit` — 0 drained, 75 salvaged, a
+#: signal = dead), but judged by the ROUTER against a flat staleness
+#: deadline (``serve_health_s``) instead of a traffic-model chunk
+#: deadline: a replica's liveness is "is it scheduling threads", not
+#: "did this chunk land on time" (its per-request deadlines are the
+#: scheduler's SLO machinery, not the supervisor's).
+SERVE_REPLICA_KIND = "serve-replica"
+
 
 # ----------------------------------------------------------------------
 # Heartbeat protocol (worker side writes, supervisor side reads).
@@ -293,6 +304,55 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+# ----------------------------------------------------------------------
+# Serve-replica children (the serving-fleet tier: serve/router.py).
+
+
+def serve_replica_argv(config_path: str, *, rank: int, port: int,
+                       heartbeat_path: str, checkpoint_dir: str,
+                       n_peers: int | None = None,
+                       extra_args: tuple[str, ...] = ()) -> list[str]:
+    """The command line for one serve-replica child: the ordinary
+    ``--serve`` CLI entered on its own port with its own checkpoint dir
+    and a ``--serve-heartbeat`` file — the whole replica contract is
+    the single-server contract plus the heartbeat stamp (which carries
+    the BOUND port, so an EADDRINUSE rebind is discovered, not
+    crashed on)."""
+    cmd = [sys.executable, "-m", "p2p_gossipprotocol_tpu.cli",
+           config_path, "--serve", "--quiet",
+           "--local-ip", "127.0.0.1",
+           "--local-port", str(port),
+           "--serve-heartbeat", heartbeat_path,
+           "--serve-rank", str(rank),
+           "--checkpoint-dir", checkpoint_dir]
+    if n_peers:
+        cmd += ["--n-peers", str(n_peers)]
+    cmd += list(extra_args)
+    return cmd
+
+
+def spawn_serve_replica(argv: list[str], *, run_dir: str,
+                        rank: int) -> subprocess.Popen:
+    """Launch one replica child the way :class:`Supervisor` launches
+    workers: its own session (reaping kills the whole process group —
+    nothing a replica forks outlives the fleet), stdout/stderr into
+    per-replica files under ``run_dir``, and the backend probe
+    suppressed (the router vetted the environment once; N replicas
+    must not each pay — or hang in — the probe)."""
+    import p2p_gossipprotocol_tpu
+
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(p2p_gossipprotocol_tpu.__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["GOSSIP_NO_BACKEND_PROBE"] = "1"
+    os.makedirs(run_dir, exist_ok=True)
+    return subprocess.Popen(
+        argv, env=env, start_new_session=True,
+        stdout=open(os.path.join(run_dir, f"replica_{rank}.out"), "ab"),
+        stderr=open(os.path.join(run_dir, f"replica_{rank}.err"), "ab"))
 
 
 class Supervisor:

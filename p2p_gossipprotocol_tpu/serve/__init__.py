@@ -30,9 +30,13 @@ docs/ARCHITECTURE.md "The serving seam" has the admission rules and
 why the bitwise contract holds.
 """
 
-from p2p_gossipprotocol_tpu.serve.scheduler import (Request, Scheduler,
-                                                    ServeReject)
+from p2p_gossipprotocol_tpu.serve.scheduler import (SHED_AT_ADMISSION,
+                                                    SHED_IN_QUEUE,
+                                                    SHED_ON_DRAIN, Request,
+                                                    Scheduler, ServeReject,
+                                                    ServeShed)
 from p2p_gossipprotocol_tpu.serve.service import GossipService, ServeBucket
 
 __all__ = ["GossipService", "Request", "Scheduler", "ServeBucket",
-           "ServeReject"]
+           "ServeReject", "ServeShed", "SHED_AT_ADMISSION",
+           "SHED_IN_QUEUE", "SHED_ON_DRAIN"]

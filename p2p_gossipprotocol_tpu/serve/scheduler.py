@@ -14,7 +14,19 @@ answers three questions about it:
   new bucket (up to ``serve_max_buckets``); otherwise it waits;
 * **may it wait?**  The queue is bounded (``serve_queue_max``); a full
   queue rejects with an explicit reason — backpressure the client can
-  see, not an unbounded buffer that hides overload until OOM.
+  see, not an unbounded buffer that hides overload until OOM;
+* **is it still worth serving?**  Requests may carry SLO fields —
+  ``deadline_ms`` (admission-to-result budget) and ``priority`` —
+  stripped before scenario resolution (they shape *scheduling*, never
+  the simulated trajectory).  The queue drains earliest-deadline-first
+  within descending priority, and a request that can no longer meet its
+  deadline is SHED with a typed reason instead of executed: work the
+  client has already given up on must not displace work that can still
+  land.  The taxonomy (each its own constant, pinned by tests):
+  ``doomed-at-admission`` (dead on arrival — rejected at the door),
+  ``doomed-in-queue`` (expired while waiting), and
+  ``drain-during-overload`` (expired while a draining server worked
+  through its backlog).
 
 Latency is accounted per request at the four protocol instants the
 issue names — enqueue, admit, converge, result — all
@@ -43,6 +55,25 @@ class ServeReject(Exception):
         self.reason = reason
 
 
+class ServeShed(ServeReject):
+    """A request shed by deadline-aware admission — accepted-then-shed
+    (``result()`` raises this) or dead on arrival (``submit()`` raises
+    it).  The reason always begins with one of the ``SHED_*`` constants
+    so clients and the chaos harness can classify sheds mechanically."""
+
+
+#: typed shed reasons — the load-shedding taxonomy (docs/ROBUSTNESS.md
+#: "The serving fleet"); every shed carries exactly one of these
+SHED_AT_ADMISSION = "shed:doomed-at-admission"
+SHED_IN_QUEUE = "shed:doomed-in-queue"
+SHED_ON_DRAIN = "shed:drain-during-overload"
+
+#: request-dict keys that shape SCHEDULING, never the simulated
+#: trajectory — stripped before the scenario resolves (they are not
+#: config keys, so leaving them in would be an unknown-key rejection)
+SLO_KEYS = ("deadline_ms", "priority")
+
+
 #: request lifecycle states, in order
 QUEUED, RUNNING, DONE, FAILED = "queued", "running", "done", "failed"
 
@@ -56,6 +87,10 @@ class Request:
     spec: ScenarioSpec
     signature: tuple
     status: str = QUEUED
+    #: SLO fields (None/0 = no deadline, default priority) — stripped
+    #: from the scenario dict, so they never reach the trajectory
+    deadline_ms: float | None = None
+    priority: int = 0
     #: perf_counter stamps of the four accounting instants
     t_enqueue: float = 0.0
     t_admit: float | None = None
@@ -65,6 +100,19 @@ class Request:
     result: object | None = None       # sim.SimResult once served
     done_event: threading.Event = field(default_factory=threading.Event,
                                         repr=False)
+
+    def deadline_at(self) -> float | None:
+        """Absolute perf_counter instant this request's SLO expires, or
+        None when it carries no deadline."""
+        if self.deadline_ms is None or self.deadline_ms <= 0:
+            return None
+        return self.t_enqueue + self.deadline_ms / 1e3
+
+    def past_deadline(self, now: float | None = None) -> bool:
+        d = self.deadline_at()
+        if d is None:
+            return False
+        return (time.perf_counter() if now is None else now) >= d
 
     def latency_ms(self) -> dict:
         """The row's latency columns (admission-to-result is the
@@ -125,21 +173,72 @@ class Scheduler:
         self.queue_max = queue_max
         self.n_peers = n_peers
         self.pad_peers = pad_peers
+        # SLO policy from the base config (serve_deadline_* keys):
+        # a default admission-to-result budget for requests that carry
+        # none, and whether expired requests are shed or only ordered
+        self.deadline_default_ms = float(
+            getattr(base_cfg, "serve_deadline_ms", 0.0) or 0.0)
+        self.deadline_shed = bool(
+            getattr(base_cfg, "serve_deadline_shed", 1))
         self.requests: dict[int, Request] = {}
         self.queue: deque[int] = deque()
         self.n_rejected = 0
+        self.n_shed = 0
+        self.shed_reasons: dict[str, int] = {}
         self._next_rid = next_rid
         self._lock = threading.Lock()
         self._accepting = True
 
     # -- client side ----------------------------------------------------
+    @staticmethod
+    def split_slo(overrides: dict) -> tuple[dict, float | None, int]:
+        """``(scenario_overrides, deadline_ms, priority)`` with the SLO
+        fields stripped — the one parse both the scheduler and the
+        fleet router use, so the two doors validate identically.
+        Raises :class:`ServeReject` on a non-numeric field."""
+        ov = dict(overrides)
+        deadline_ms = ov.pop("deadline_ms", None)
+        priority = ov.pop("priority", 0)
+        if deadline_ms is not None:
+            try:
+                deadline_ms = float(deadline_ms)
+            except (TypeError, ValueError):
+                raise ServeReject(
+                    f"bad scenario: deadline_ms must be a number, got "
+                    f"{deadline_ms!r}")
+        try:
+            priority = int(priority)
+        except (TypeError, ValueError):
+            raise ServeReject(
+                f"bad scenario: priority must be an integer, got "
+                f"{priority!r}")
+        return ov, deadline_ms, priority
+
     def submit(self, overrides: dict, rid: int | None = None) -> Request:
         """Resolve + enqueue one request; raises :class:`ServeReject`
-        (draining server, full queue, unresolvable scenario).  ``rid``
-        is only passed by resume re-hydration, which must keep the
-        original ids."""
+        (draining server, full queue, unresolvable scenario) or
+        :class:`ServeShed` (dead on arrival).  ``rid`` is only passed
+        by resume re-hydration, which must keep the original ids."""
         from p2p_gossipprotocol_tpu import telemetry
 
+        overrides, deadline_ms, priority = self.split_slo(overrides)
+        if deadline_ms is None and self.deadline_default_ms > 0:
+            deadline_ms = self.deadline_default_ms
+        if deadline_ms is not None and deadline_ms <= 0 \
+                and self.deadline_shed:
+            # dead on arrival: the client's budget was spent before the
+            # request reached the door — executing it can only displace
+            # work that can still land.  Typed, never enqueued.
+            with self._lock:
+                self.n_shed += 1
+                self.shed_reasons[SHED_AT_ADMISSION] = \
+                    self.shed_reasons.get(SHED_AT_ADMISSION, 0) + 1
+            telemetry.counter_add("serve_shed_total")
+            telemetry.event("shed", reason=SHED_AT_ADMISSION,
+                            deadline_ms=deadline_ms)
+            raise ServeShed(
+                f"{SHED_AT_ADMISSION}: deadline_ms={deadline_ms:g} "
+                "already expired at submission — not executed")
         with self._lock:
             if not self._accepting:
                 self.n_rejected += 1
@@ -170,6 +269,7 @@ class Scheduler:
             raise
         req = Request(rid=rid, overrides=dict(overrides), spec=spec,
                       signature=bucket_signature(spec.sim),
+                      deadline_ms=deadline_ms, priority=priority,
                       t_enqueue=time.perf_counter())
         with self._lock:
             # re-check the bound under the lock (resolution dropped it)
@@ -191,9 +291,56 @@ class Scheduler:
 
     # -- serving-loop side ---------------------------------------------
     def queued(self) -> list[Request]:
-        """Snapshot of waiting requests in FIFO order."""
+        """Snapshot of waiting requests in admission order:
+        earliest-deadline-first within descending priority, FIFO among
+        equals (no deadline sorts after every deadline — bounded work
+        beats unbounded).  Python's sort is stable, so the FIFO queue
+        order is the tiebreak by construction."""
         with self._lock:
-            return [self.requests[r] for r in self.queue]
+            reqs = [self.requests[r] for r in self.queue]
+        return sorted(reqs, key=lambda r: (
+            -r.priority, r.deadline_at() if r.deadline_at() is not None
+            else float("inf")))
+
+    def shed(self, req: Request, reason: str) -> None:
+        """Drop a QUEUED request with a typed reason: removed from the
+        queue, marked FAILED with a ``shed`` row (``result()`` raises
+        :class:`ServeShed` with the reason), never executed."""
+        from p2p_gossipprotocol_tpu import telemetry
+
+        with self._lock:
+            try:
+                self.queue.remove(req.rid)
+            except ValueError:
+                return                      # already admitted or shed
+            self.n_shed += 1
+            self.shed_reasons[reason] = \
+                self.shed_reasons.get(reason, 0) + 1
+        telemetry.counter_add("serve_shed_total")
+        telemetry.event("shed", reason=reason, request=req.rid,
+                        deadline_ms=req.deadline_ms,
+                        priority=req.priority)
+        self.finish(req, {"request": req.rid, "shed": reason,
+                          "error": f"{reason}: deadline_ms="
+                                   f"{req.deadline_ms or 0:g} expired "
+                                   "before admission — not executed"},
+                    failed=True)
+
+    def shed_doomed(self, draining: bool = False) -> int:
+        """Shed every queued request already past its deadline (the
+        admit-boundary sweep — a doomed request must never reach a
+        slot).  ``draining`` selects the taxonomy entry: the same
+        expiry during a drain is the drain-during-overload path."""
+        if not self.deadline_shed:
+            return 0
+        now = time.perf_counter()
+        reason = SHED_ON_DRAIN if draining else SHED_IN_QUEUE
+        n = 0
+        for req in self.queued():
+            if req.past_deadline(now):
+                self.shed(req, reason)
+                n += 1
+        return n
 
     def mark_admitted(self, req: Request) -> None:
         with self._lock:
@@ -222,20 +369,25 @@ class Scheduler:
         with self._lock:
             reqs = list(self.requests.values())
             n_queued = len(self.queue)
-            # n_rejected is written under the lock (submit) — read it
-            # in the same snapshot, not after (gossip-lint
-            # lock-discipline)
+            # n_rejected/n_shed are written under the lock (submit,
+            # shed) — read them in the same snapshot, not after
+            # (gossip-lint lock-discipline)
             n_rejected = self.n_rejected
+            n_shed = self.n_shed
+            shed_reasons = dict(self.shed_reasons)
         lat = [r.t_result - r.t_enqueue for r in reqs
                if r.status == DONE and r.t_result is not None]
         out = {
             "submitted": len(reqs),
             "rejected": n_rejected,
+            "shed": n_shed,
             "queued": n_queued,
             "running": sum(1 for r in reqs if r.status == RUNNING),
             "done": sum(1 for r in reqs if r.status == DONE),
             "failed": sum(1 for r in reqs if r.status == FAILED),
         }
+        if shed_reasons:
+            out["shed_reasons"] = shed_reasons
         if lat:
             a = np.asarray(lat) * 1e3
             out["p50_ms"] = round(float(np.percentile(a, 50)), 3)

@@ -10,7 +10,9 @@ both ways:
 request      fields                                 response
 ===========  =====================================  ====================
 ``submit``   ``scenario`` (a JSONL-line config      ``accepted`` (id) or
-             dict — the sweep override surface)     ``rejected`` (reason)
+             dict — the sweep override surface —    ``rejected`` (reason;
+             plus optional SLO fields               sheds are typed
+             ``deadline_ms``/``priority``)          ``shed:*`` reasons)
 ``result``   ``id``, optional ``timeout`` (s)       ``result`` (row) /
                                                     ``pending`` / error
 ``stats``    —                                      ``stats`` (p50/p99
@@ -39,8 +41,10 @@ caller — the bench/benchmark drivers and the tests speak through it.
 
 from __future__ import annotations
 
+import errno
 import socket
 import threading
+import time
 
 from p2p_gossipprotocol_tpu.serve.scheduler import ServeReject
 from p2p_gossipprotocol_tpu.transport.socket_transport import (
@@ -58,6 +62,9 @@ class ServeServer:
         self.transport = SocketTransport(ip, port)
         self.send, self.stream_cls = WIRE_FORMATS[wire_format]
         self.log = log
+        #: the port start() wanted but lost to a bind race (None = the
+        #: requested bind held) — the record the exit-4 contract keeps
+        self.rebound_from: int | None = None
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._accept_thread: threading.Thread | None = None
@@ -70,8 +77,38 @@ class ServeServer:
             return self.transport.listener.getsockname()[1]
         return self.transport.port
 
-    def start(self) -> "ServeServer":
-        self.transport.start()
+    def start(self, on_bound=None) -> "ServeServer":
+        """Bind (rebinding on an EADDRINUSE race), then start the
+        service and the accept loop.  ``on_bound(port)`` runs between
+        bind and service start — the seam the replica CLI uses to arm
+        the heartbeat with the REAL port before serving begins."""
+        try:
+            self.transport.start()
+        except OSError as e:
+            if e.errno != errno.EADDRINUSE:
+                raise
+            # a port race is nobody's failure: rebind on a fresh
+            # ephemeral port and RECORD it — the in-process mirror of
+            # the supervisor's exit-4 (EX_REBIND) contract, where a
+            # worker that loses the coordinator bind race relaunches
+            # on a fresh port instead of being evicted.  The replica
+            # heartbeat carries the real port, so the fleet router
+            # (and any operator reading the log) finds the server.
+            from p2p_gossipprotocol_tpu import telemetry
+
+            self.rebound_from = self.transport.port
+            self.transport = SocketTransport(self.transport.ip, 0)
+            self.transport.start()
+            telemetry.event("serve_rebind",
+                            lost_port=self.rebound_from,
+                            port=self.port)
+            telemetry.counter_add("serve_rebinds_total")
+            if self.log:
+                self.log(f"[serve] port {self.rebound_from} already "
+                         f"in use — rebound on fresh port {self.port} "
+                         "(the supervisor's exit-4 rule, in-process)")
+        if on_bound is not None:
+            on_bound(self.port)
         self.service.start()
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
@@ -214,22 +251,96 @@ class ServeServer:
 
 
 class ServeClient:
-    """Caller half of the protocol (tests, bench, load drivers)."""
+    """Caller half of the protocol (tests, bench, the fleet router,
+    load drivers) — with the resilient-send discipline the socket peer
+    runtime established (peer.py ``_send_resilient``, ``faults.py``):
+
+    * **connect timeout** (``timeout``) bounds every TCP connect;
+    * **read timeout** bounds how long an RPC waits for its reply
+      beyond any server-side wait it declared (``result``'s blocking
+      ``timeout`` rides on top) — a quiet wire surfaces
+      ``TimeoutError`` instead of wedging the caller forever;
+    * **bounded retry-with-backoff** on TRANSPORT errors — refused or
+      timed-out connects, resets, EOF mid-RPC: the client reconnects
+      to the same address and replays the document, exponentially
+      backed off, at most ``retries`` times.  A read-deadline expiry is
+      NOT retried (the connection is healthy; replaying could
+      double-submit onto a merely-slow server).  The replay makes the
+      protocol at-most-once-per-attempt: a ``submit`` whose reply died
+      with the socket may re-register on replay — the fleet router
+      de-duplicates by ITS request id, which is why recovery counts
+      zero duplicates even through retries.
+    """
+
+    RETRIES = 2
+    BACKOFF_S = 0.05
 
     def __init__(self, ip: str, port: int, wire_format: str = "json",
-                 timeout: float = 10.0):
-        self.sock = socket.create_connection((ip, port), timeout=timeout)
-        self.send, stream_cls = WIRE_FORMATS[wire_format]
-        self.stream = stream_cls(self.sock)
+                 timeout: float = 10.0, read_timeout: float = 30.0,
+                 retries: int | None = None,
+                 backoff_s: float | None = None):
+        self.ip = ip
+        self.port = port
+        self.connect_timeout = timeout
+        self.read_timeout = read_timeout
+        self.retries = self.RETRIES if retries is None else int(retries)
+        self.backoff_s = (self.BACKOFF_S if backoff_s is None
+                          else float(backoff_s))
+        self.send, self._stream_cls = WIRE_FORMATS[wire_format]
+        self.reconnects = 0              # transport-error reconnects
+        self.sock: socket.socket | None = None
+        self.stream = None
+        self._connect()
 
-    def _rpc(self, obj: dict) -> dict:
-        self.send(self.sock, obj)
-        while True:
-            docs = self.stream.recv_objects()
-            if docs is None:
-                raise ConnectionError("server closed the connection")
-            if docs:
-                return docs[0]
+    def _connect(self) -> None:
+        self.sock = socket.create_connection(
+            (self.ip, self.port), timeout=self.connect_timeout)
+        # short recv slices: socket.timeout inside recv_objects comes
+        # back as [] (healthy, nothing yet), so the read deadline below
+        # is enforced by the loop, not by one giant blocking recv
+        self.sock.settimeout(0.5)
+        self.stream = self._stream_cls(self.sock)
+
+    def _rpc(self, obj: dict, wait_s: float = 0.0) -> dict:
+        """Send one document, return its reply.  ``wait_s`` is the
+        server-side wait the call declared (``result``'s blocking
+        timeout) — added to the read deadline so a deliberately slow
+        reply is not misread as a dead wire."""
+        delay = self.backoff_s
+        for attempt in range(self.retries + 1):
+            sent = False
+            try:
+                if self.sock is None:
+                    self._connect()
+                self.send(self.sock, obj)
+                sent = True
+                deadline = time.monotonic() + wait_s + self.read_timeout
+                while True:
+                    docs = self.stream.recv_objects()
+                    if docs is None:
+                        raise ConnectionError(
+                            "server closed the connection")
+                    if docs:
+                        return docs[0]
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"no reply from {self.ip}:{self.port} "
+                            f"within {wait_s + self.read_timeout:g}s")
+            except (ConnectionError, OSError) as e:
+                if isinstance(e, TimeoutError) and sent:
+                    # quiet-but-alive wire: replaying onto it could
+                    # double-submit; surface instead
+                    raise
+                self.close()
+                if attempt >= self.retries:
+                    raise ConnectionError(
+                        f"RPC to {self.ip}:{self.port} failed after "
+                        f"{attempt + 1} attempt(s): "
+                        f"{type(e).__name__}: {e}") from e
+                time.sleep(delay)
+                delay *= 2
+                self.reconnects += 1
+        raise ConnectionError("unreachable")       # loop always returns
 
     def submit(self, scenario: dict) -> int:
         """Submit one scenario; returns the request id or raises
@@ -241,7 +352,7 @@ class ServeClient:
 
     def result(self, rid: int, timeout: float = 600.0) -> dict:
         resp = self._rpc({"type": "result", "id": rid,
-                          "timeout": timeout})
+                          "timeout": timeout}, wait_s=timeout)
         if resp.get("type") == "result":
             return resp["row"]
         if resp.get("type") == "pending":
@@ -270,16 +381,21 @@ class ServeClient:
         ``{"trace", "duration_s", "ops"}`` (see
         ``GossipService.profile_capture``)."""
         resp = self._rpc({"type": "profile", "duration_s": duration_s,
-                          "top_n": top_n})
+                          "top_n": top_n}, wait_s=duration_s + 30.0)
         if resp.get("type") != "profile":
             raise RuntimeError(resp.get("reason", str(resp)))
         return resp
 
-    def drain(self) -> dict:
-        return self._rpc({"type": "drain"})
+    def drain(self, wait_s: float = 600.0) -> dict:
+        # drain finishes everything already admitted before replying —
+        # give it a run-scale wait, not the RPC-scale read timeout
+        return self._rpc({"type": "drain"}, wait_s=wait_s)
 
     def close(self) -> None:
-        try:
-            self.sock.close()
-        except OSError:
-            pass
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+        self.sock = None
+        self.stream = None

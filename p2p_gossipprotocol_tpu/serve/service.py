@@ -59,6 +59,7 @@ from p2p_gossipprotocol_tpu.fleet.engine import (METRIC_DTYPES,
 from p2p_gossipprotocol_tpu.serve.scheduler import (DONE, FAILED, QUEUED,
                                                     RUNNING, Request,
                                                     Scheduler, ServeReject,
+                                                    ServeShed,
                                                     resolve_request)
 
 #: serve manifest schema (the sweep manifest's sibling; fingerprint /
@@ -230,7 +231,7 @@ class GossipService:
                  target: float | None = None, rounds: int | None = None,
                  checkpoint_dir: str | None = None,
                  results_path: str | None = None, resume: bool = False,
-                 log=None):
+                 persist_every_s: float = 0.0, log=None):
         from p2p_gossipprotocol_tpu.engines import probe_backend
 
         probe_backend()
@@ -254,6 +255,24 @@ class GossipService:
                 slots=self.slots, rounds=self.rounds)
         self.checkpoint_dir = checkpoint_dir or cfg.checkpoint_dir or None
         self.results_path = results_path or cfg.serve_results or None
+        # periodic persistence (serve-fleet replicas): the salvage
+        # snapshot a SIGTERM writes once is refreshed every N seconds
+        # at a chunk boundary, so even a SIGKILL — which runs no
+        # handler — leaves a recent manifest whose completed rows the
+        # router replays instead of re-executing (zero lost work,
+        # rid-deduped).  0 = off (the single-server default).
+        self.persist_every_s = float(persist_every_s or 0.0)
+        self._last_persist = time.perf_counter()
+        # replica heartbeat (runtime/supervisor.py file contract): a
+        # dedicated thread refreshes it sub-second, independent of
+        # chunk length — SIGSTOP freezes the thread and the router's
+        # staleness deadline fires; process death is caught by the
+        # router's proc.poll().  Configured by the CLI before start().
+        self.heartbeat_path: str | None = None
+        self.heartbeat_port: int = 0
+        self.heartbeat_rank: int = 0
+        self._hb_stop = threading.Event()
+        self._hb_thread: threading.Thread | None = None
         self.log = log
         self.scheduler = Scheduler(
             cfg, queue_max or cfg.serve_queue_max, n_peers=n_peers,
@@ -291,11 +310,41 @@ class GossipService:
             {"serve_base": config_keys(self.cfg, n_peers=self.n_peers)})
 
     # -- lifecycle ------------------------------------------------------
+    def configure_heartbeat(self, path: str, port: int,
+                            rank: int = 0) -> None:
+        """Arm the serve-replica heartbeat (call before start()): the
+        file at ``path`` is refreshed every 0.2 s with the replica's
+        bound ``port`` — how the fleet router discovers where a replica
+        actually listens (an EADDRINUSE rebind lands here) and judges
+        its liveness (runtime/supervisor.py file contract)."""
+        self.heartbeat_path = path
+        self.heartbeat_port = int(port)
+        self.heartbeat_rank = int(rank)
+
+    def _hb_loop(self) -> None:
+        from p2p_gossipprotocol_tpu.runtime.supervisor import \
+            write_heartbeat
+
+        while not self._hb_stop.is_set():
+            try:
+                write_heartbeat(
+                    self.heartbeat_path, rank=self.heartbeat_rank,
+                    phase="run",
+                    extra={"kind": "serve-replica",
+                           "port": self.heartbeat_port})
+            except OSError:
+                pass                      # a torn disk never kills serving
+            self._hb_stop.wait(0.2)
+
     def start(self) -> "GossipService":
         if self._thread is not None:
             return self
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+        if self.heartbeat_path and self._hb_thread is None:
+            self._hb_thread = threading.Thread(target=self._hb_loop,
+                                               daemon=True)
+            self._hb_thread.start()
         return self
 
     def is_running(self) -> bool:
@@ -329,6 +378,10 @@ class GossipService:
             raise TimeoutError(f"request {rid} not done within "
                                f"{timeout}s")
         if req.status == FAILED:
+            if (req.row or {}).get("shed"):
+                # shed, not failed: typed — the client can distinguish
+                # "your deadline expired" from "the server broke"
+                raise ServeShed(req.row["error"])
             if self._error is not None:
                 raise self._error
             raise RuntimeError(
@@ -421,6 +474,7 @@ class GossipService:
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout)
+        self._hb_stop.set()
         if self._error is not None:
             raise self._error
         return self.stats()
@@ -437,6 +491,7 @@ class GossipService:
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout)
+        self._hb_stop.set()
         if self._error is not None:
             raise self._error
         return self.stats()
@@ -462,6 +517,12 @@ class GossipService:
         return b
 
     def _admit_pending(self) -> int:
+        # the admit-boundary SLO sweep: a request already past its
+        # deadline is shed with a typed reason, never handed a slot —
+        # and queued() orders the survivors earliest-deadline-first
+        # within priority, so under overload the slots go to requests
+        # that can still land
+        self.scheduler.shed_doomed(draining=self._draining.is_set())
         n = 0
         for req in self.scheduler.queued():
             b = self._bucket_for(req)
@@ -498,6 +559,11 @@ class GossipService:
                "request": req.rid, "bucket": bucket_id,
                "rounds_run": int(r_i),
                "converged": bool(occ.converged > 0)}
+        if req.deadline_ms is not None:
+            row["deadline_ms"] = req.deadline_ms
+            row["deadline_met"] = not req.past_deadline()
+        if req.priority:
+            row["priority"] = req.priority
         if r_i:
             row["final_coverage"] = float(res.coverage[-1])
             row["total_deliveries"] = int(round(
@@ -540,6 +606,14 @@ class GossipService:
                     self._wake.wait(0.02)
                     self._wake.clear()
                     continue
+                if self.persist_every_s > 0 and self.checkpoint_dir \
+                        and (time.perf_counter() - self._last_persist
+                             >= self.persist_every_s):
+                    # fleet-replica discipline: refresh the salvage
+                    # snapshot so a SIGKILL (no handler runs) still
+                    # leaves a recent manifest for the router to replay
+                    self._persist_all(dump=False)
+                    self._last_persist = time.perf_counter()
                 for b in active:
                     # clamp the final chunk so rounds_run never exceeds
                     # the serve_rounds cap (chunk boundaries need not
@@ -585,22 +659,30 @@ class GossipService:
     def _bucket_path(self, b: int) -> str:
         return os.path.join(self.checkpoint_dir, f"serve_bucket_{b}.npz")
 
-    def _persist_all(self) -> None:
+    def _persist_all(self, dump: bool = True) -> None:
         """Persist the whole serving state at a chunk boundary: the
-        queue (request ids + overrides, FIFO order), completed rows,
-        and every live bucket's CRC'd snapshot — the sweep driver's
-        torn-write discipline (payload lands, then the manifest commits
-        atomically)."""
+        queue (request ids + overrides + SLO fields, FIFO order),
+        completed rows, and every live bucket's CRC'd snapshot — the
+        sweep driver's torn-write discipline (payload lands, then the
+        manifest commits atomically).  ``dump=False`` is the periodic
+        fleet-replica refresh (no flight-recorder dump per tick)."""
         from p2p_gossipprotocol_tpu.utils.checkpoint import (_crc_entry,
                                                              _write_atomic)
+
+        def _q_item(r):
+            item = {"rid": r.rid, "overrides": r.overrides}
+            if r.deadline_ms is not None:
+                item["deadline_ms"] = r.deadline_ms
+            if r.priority:
+                item["priority"] = r.priority
+            return item
 
         os.makedirs(self.checkpoint_dir, exist_ok=True)
         manifest = {
             "schema": SERVE_SCHEMA, "kind": "serve",
             "fingerprint": self._fingerprint(),
             "next_rid": self.scheduler._next_rid,
-            "queued": [{"rid": r.rid, "overrides": r.overrides}
-                       for r in self.scheduler.queued()],
+            "queued": [_q_item(r) for r in self.scheduler.queued()],
             "done": {str(r.rid): r.row
                      for r in self.scheduler.requests.values()
                      if r.status == DONE and r.row is not None},
@@ -644,6 +726,8 @@ class GossipService:
             })
         _write_atomic(self._manifest_path(),
                       json.dumps(manifest, sort_keys=True))
+        if not dump:
+            return
         # flight-recorder dump ALONGSIDE the salvage (the exit-75
         # contract grew a black box): the post-mortem of a preempted
         # server ships its own spans/events/counters
@@ -753,8 +837,15 @@ class GossipService:
             b.done = jnp.asarray(payload["mask/done"])
             self.buckets.append(b)
         for item in manifest.get("queued", []):
-            self.scheduler.submit(item["overrides"],
-                                  rid=int(item["rid"]))
+            ov = dict(item["overrides"])
+            # SLO fields ride the manifest beside the overrides; the
+            # deadline clock restarts at re-enqueue (the original
+            # enqueue instant died with the preempted process)
+            if item.get("deadline_ms") is not None:
+                ov["deadline_ms"] = item["deadline_ms"]
+            if item.get("priority"):
+                ov["priority"] = item["priority"]
+            self.scheduler.submit(ov, rid=int(item["rid"]))
         if self.log:
             self.log(f"[serve] resumed {len(self.buckets)} bucket(s), "
                      f"{len(manifest.get('queued', []))} queued "

@@ -510,6 +510,213 @@ def test_cli_serve_sigterm_salvage_resume(base_cfg, tmp_path):
     assert {r["request"] for r in table} >= set(rids)
 
 
+# ---------------------------------------------------------------------
+# SLO-aware admission: deadline ordering + the shed taxonomy
+
+def test_scheduler_deadline_ordering(base_cfg):
+    """The queue drains earliest-deadline-first within descending
+    priority, FIFO among equals; requests without a deadline sort
+    after every deadline.  (Loop never started — pure policy.)"""
+    from p2p_gossipprotocol_tpu.serve import GossipService
+
+    svc = GossipService(base_cfg, slots=2, queue_max=16, target=0.99)
+    r_loose = svc.submit({"prng_seed": 1, "deadline_ms": 50_000})
+    r_tight = svc.submit({"prng_seed": 2, "deadline_ms": 1_000})
+    r_prio = svc.submit({"prng_seed": 3, "priority": 5})
+    r_none = svc.submit({"prng_seed": 4})
+    order = [r.rid for r in svc.scheduler.queued()]
+    assert order == [r_prio, r_tight, r_loose, r_none], order
+    # FIFO stays the tiebreak among equals
+    r_none2 = svc.submit({"prng_seed": 5})
+    order = [r.rid for r in svc.scheduler.queued()]
+    assert order[-2:] == [r_none, r_none2]
+
+
+def test_shed_doomed_at_admission(base_cfg):
+    """A request whose deadline is already spent at submission is shed
+    at the door with the typed reason — never enqueued, never
+    executed."""
+    from p2p_gossipprotocol_tpu.serve import (SHED_AT_ADMISSION,
+                                              GossipService, ServeShed)
+
+    svc = GossipService(base_cfg, slots=2, queue_max=16, target=0.99)
+    with pytest.raises(ServeShed, match="doomed-at-admission"):
+        svc.submit({"prng_seed": 0, "deadline_ms": 0})
+    with pytest.raises(ServeShed, match="doomed-at-admission"):
+        svc.submit({"prng_seed": 0, "deadline_ms": -5})
+    st = svc.stats()
+    assert st["shed"] == 2 and st["submitted"] == 0
+    assert st["shed_reasons"] == {SHED_AT_ADMISSION: 2}
+    # malformed SLO fields are named rejections, not sheds
+    from p2p_gossipprotocol_tpu.serve import ServeReject
+
+    with pytest.raises(ServeReject, match="deadline_ms must be"):
+        svc.submit({"prng_seed": 0, "deadline_ms": "soon"})
+    with pytest.raises(ServeReject, match="priority must be"):
+        svc.submit({"prng_seed": 0, "priority": "high"})
+
+
+def test_shed_doomed_in_queue_and_drain_paths(base_cfg):
+    """The admit-boundary sweep sheds queued requests whose deadline
+    expired while waiting — doomed-in-queue normally, the
+    drain-during-overload reason when the server is draining — and
+    result() raises the typed ServeShed instead of faking a row."""
+    from p2p_gossipprotocol_tpu.serve import (SHED_IN_QUEUE,
+                                              SHED_ON_DRAIN,
+                                              GossipService, ServeShed)
+
+    svc = GossipService(base_cfg, slots=2, queue_max=16, target=0.99)
+    rid_q = svc.submit({"prng_seed": 1, "deadline_ms": 1})
+    time.sleep(0.05)
+    assert svc.scheduler.shed_doomed(draining=False) == 1
+    with pytest.raises(ServeShed, match="doomed-in-queue"):
+        svc.result(rid_q, timeout=1)
+    rid_d = svc.submit({"prng_seed": 2, "deadline_ms": 1})
+    time.sleep(0.05)
+    assert svc.scheduler.shed_doomed(draining=True) == 1
+    with pytest.raises(ServeShed, match="drain-during-overload"):
+        svc.result(rid_d, timeout=1)
+    st = svc.stats()
+    assert st["shed_reasons"] == {SHED_IN_QUEUE: 1, SHED_ON_DRAIN: 1}
+    # a healthy request is untouched by the sweep
+    svc.submit({"prng_seed": 3, "deadline_ms": 60_000})
+    assert svc.scheduler.shed_doomed(draining=False) == 0
+    assert len(svc.scheduler.queued()) == 1
+
+
+def test_deadline_shed_off_orders_but_never_sheds(tmp_path):
+    """serve_deadline_shed=0: the EDF ordering stays, the sweep is a
+    no-op, and a dead-on-arrival request is still accepted (recorded
+    policy, not silent)."""
+    from p2p_gossipprotocol_tpu.serve import GossipService
+
+    p = tmp_path / "noshed.txt"
+    p.write_text(BASE_CFG + "serve_deadline_shed=0\n")
+    cfg = NetworkConfig(str(p))
+    svc = GossipService(cfg, slots=2, queue_max=16, target=0.99)
+    rid = svc.submit({"prng_seed": 0, "deadline_ms": 1})
+    time.sleep(0.05)
+    assert svc.scheduler.shed_doomed(draining=False) == 0
+    assert [r.rid for r in svc.scheduler.queued()] == [rid]
+
+
+def test_serve_deadline_ms_default_applies(tmp_path):
+    """serve_deadline_ms stamps a default budget on requests that
+    carry none; an explicit deadline_ms wins."""
+    from p2p_gossipprotocol_tpu.serve import GossipService
+
+    p = tmp_path / "slo.txt"
+    p.write_text(BASE_CFG + "serve_deadline_ms=30000\n")
+    cfg = NetworkConfig(str(p))
+    svc = GossipService(cfg, slots=2, queue_max=16, target=0.99)
+    r_default = svc.submit({"prng_seed": 0})
+    r_explicit = svc.submit({"prng_seed": 1, "deadline_ms": 5000})
+    reqs = {r.rid: r for r in svc.scheduler.queued()}
+    assert reqs[r_default].deadline_ms == 30000
+    assert reqs[r_explicit].deadline_ms == 5000
+
+
+# ---------------------------------------------------------------------
+# wire hardening: client retry-with-backoff, server port rebind
+
+def test_serve_client_retries_after_midrpc_socket_kill():
+    """The resilient-send discipline on the serve wire: a stub server
+    kills the FIRST connection mid-RPC (request read, socket closed,
+    no reply); the client reconnects with backoff and completes the
+    RPC on the second connection."""
+    import socket as _socket
+    import threading as _threading
+
+    from p2p_gossipprotocol_tpu.serve.server import ServeClient
+    from p2p_gossipprotocol_tpu.transport.socket_transport import (
+        JsonStream, send_json)
+
+    lst = _socket.socket()
+    lst.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(4)
+    port = lst.getsockname()[1]
+    n_conns = []
+
+    def server():
+        for i in range(2):
+            conn, _ = lst.accept()
+            n_conns.append(i)
+            stream = JsonStream(conn)
+            while True:
+                docs = stream.recv_objects()
+                if docs is None:
+                    break
+                if docs:
+                    break
+            if i == 0:
+                conn.close()            # mid-RPC kill: no reply
+            else:
+                send_json(conn, {"type": "stats", "done": 7})
+                conn.close()
+
+    t = _threading.Thread(target=server, daemon=True)
+    t.start()
+    try:
+        c = ServeClient("127.0.0.1", port, timeout=5,
+                        read_timeout=10, retries=2, backoff_s=0.01)
+        resp = c.stats()
+        assert resp["done"] == 7
+        assert len(n_conns) == 2, "retry path never reconnected"
+        assert c.reconnects == 1
+        c.close()
+    finally:
+        lst.close()
+        t.join(timeout=5)
+
+
+def test_serve_client_bounded_retries_then_raises():
+    """A permanently dead address exhausts the bounded retry budget
+    and surfaces ConnectionError — never an unbounded spin."""
+    import socket as _socket
+
+    from p2p_gossipprotocol_tpu.serve.server import ServeClient
+
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()                            # nothing listens here now
+    t0 = time.perf_counter()
+    with pytest.raises((ConnectionError, OSError)):
+        ServeClient("127.0.0.1", port, timeout=0.5, retries=2,
+                    backoff_s=0.01)
+    assert time.perf_counter() - t0 < 30
+
+
+def test_serve_server_rebinds_on_eaddrinuse(base_cfg):
+    """A port race is not a crash: the server rebinds on a fresh
+    ephemeral port, records the lost one (the supervisor's exit-4
+    contract, in-process), and serves normally."""
+    import socket as _socket
+
+    from p2p_gossipprotocol_tpu.serve import GossipService
+    from p2p_gossipprotocol_tpu.serve.server import (ServeClient,
+                                                     ServeServer)
+
+    squatter = _socket.socket()
+    squatter.bind(("127.0.0.1", 0))
+    squatter.listen(1)
+    stolen = squatter.getsockname()[1]
+    svc = GossipService(base_cfg, slots=2, target=0.99, rounds=32)
+    server = ServeServer(svc, "127.0.0.1", stolen)
+    try:
+        server.start()
+        assert server.rebound_from == stolen
+        assert server.port != stolen
+        c = ServeClient("127.0.0.1", server.port)
+        st = c.stats()
+        assert st["type"] == "stats"
+        c.close()
+    finally:
+        server.stop()
+        squatter.close()
+
+
 def test_wrapper_refuses_serve(tmp_path):
     from p2p_gossipprotocol_tpu.wrapper import Peer
 
