@@ -48,7 +48,12 @@ GOSSIP_BENCH_PREFETCH (0; -1/2 = auto/force the round-10
 double-buffered DMA stream — bitwise-identical to the pipelined path;
 the A/B lives in benchmarks/measure_round10.py),
 GOSSIP_BENCH_ROOF_GB_S (800, the v5e HBM roof the roofline_frac
-column divides by), GOSSIP_BENCH_HOSTS (0; > 1 adds the round-11
+column divides by), GOSSIP_BENCH_FRONTIER_ALGO (-1; 0/1 = force the
+gather / recursive-halving execution of the sparse exchange — round
+16), GOSSIP_BENCH_EXCHANGE_SHARDS (0; > 1 adds the round-16
+exchange columns: per-chip received bytes of one sparse exchange
+round under the gather vs the halving execution, closed-form and
+reproducible from the row alone), GOSSIP_BENCH_HOSTS (0; > 1 adds the round-11
 per-tier exchange columns — ``ici_gb``/``dcn_gb`` per-chip per-round
 interconnect bytes under a GOSSIP_BENCH_HOSTS x GOSSIP_BENCH_HOST_DEVS
 (default 4) hierarchical factorization, sourced from
@@ -319,6 +324,12 @@ def _bench_aligned(n, n_msgs, degree, mode):
     # benchmarks/measure_round8.py, and the engine's own AUTO rule
     # (on for the compiled path) governs production runs.
     frontier_mode = _env_int("GOSSIP_BENCH_FRONTIER", 0)
+    # Round-16 sparse-allreduce execution of the delta exchange:
+    # -1 auto / 0 gather / 1 recursive halving.  Auto so the resolved
+    # value (gather under interpret, halving compiled) self-describes
+    # the row; the headline scenario is solo, so the knob only shapes
+    # the exchange COLUMNS below and the resolved_statics record.
+    frontier_algo = _env_int("GOSSIP_BENCH_FRONTIER_ALGO", -1)
     # Round-10 double-buffered DMA stream: bench default stays 0 so
     # headline rows remain comparable across rounds (the frontier
     # precedent); the engine's own AUTO (-1) governs production runs
@@ -368,7 +379,7 @@ def _bench_aligned(n, n_msgs, degree, mode):
     graph_s = time.perf_counter() - t0
     plan = _fault_plan()
 
-    def _mk_sim(pw, fm=None, pd=None, ft=None):
+    def _mk_sim(pw, fm=None, pd=None, ft=None, fa=None):
         kw = {}
         if ft is not None:
             kw["frontier_threshold"] = ft
@@ -379,6 +390,7 @@ def _bench_aligned(n, n_msgs, degree, mode):
             message_stagger=stagger,
             fuse_update=fuse_update, pull_window=pw, faults=plan,
             frontier_mode=frontier_mode if fm is None else fm,
+            frontier_algo=frontier_algo if fa is None else fa,
             prefetch_depth=prefetch_depth if pd is None else pd,
             seed=0, **kw)
 
@@ -400,23 +412,28 @@ def _bench_aligned(n, n_msgs, degree, mode):
         tune_sig,
         requested={"frontier_mode": frontier_mode,
                    "frontier_threshold": -1.0,
+                   "frontier_algo": frontier_algo,
                    "prefetch_depth": prefetch_depth},
         heuristics={
             "frontier_mode": int(tuning_resolve.heuristic_on(
                 frontier_mode, sim.interpret)),
             "frontier_threshold":
                 tuning_resolve.heuristic_frontier_threshold(-1.0),
+            "frontier_algo": int(tuning_resolve.heuristic_on(
+                frontier_algo, sim.interpret)),
             "prefetch_depth": tuning_resolve.heuristic_prefetch(
                 prefetch_depth, sim.interpret)},
         legal={"frontier_mode": lambda v: v in (0, 1),
                "frontier_threshold": lambda v:
                    isinstance(v, (int, float)) and 0.0 < v <= 1.0,
+               "frontier_algo": lambda v: v in (0, 1),
                "prefetch_depth": lambda v: v in (0, 2)})
     if tuned.substituted:
         st = tuned.statics
         sim = _mk_sim(pull_window, fm=int(st["frontier_mode"]),
                       pd=int(st["prefetch_depth"]),
-                      ft=float(st["frontier_threshold"]))
+                      ft=float(st["frontier_threshold"]),
+                      fa=int(st["frontier_algo"]))
     state, topo2, rounds, wall = sim.run_to_coverage(
         target=TARGET_COV, max_rounds=MAX_ROUNDS, check_every=check_every)
     _check_converged(aligned_coverage(sim, state, topo2), rounds)
@@ -451,6 +468,36 @@ def _bench_aligned(n, n_msgs, degree, mode):
                 "dcn_bytes_round": int(tm_h["dcn_gather"]),
                 "ici_gb": round(tm_h["ici_gather"] / 1e9, 6),
                 "dcn_gb": round(tm_h["dcn_gather"] / 1e9, 6)}
+    # Round-16 exchange columns: GOSSIP_BENCH_EXCHANGE_SHARDS > 1 adds
+    # the per-chip received bytes of ONE sparse exchange round under
+    # each execution — the table all-gather vs the recursive-halving
+    # butterfly — plus which one this run's resolved frontier_algo
+    # would execute.  Pure closed form (frontier_capacity +
+    # halving_steps ride the row), so every column is reproducible
+    # from the artifacts alone, the roofline_frac discipline; the
+    # measured A/B with parity assertions lives in
+    # benchmarks/measure_round16.py.
+    exchange = {}
+    ex_shards = _env_int("GOSSIP_BENCH_EXCHANGE_SHARDS", 0)
+    if ex_shards > 1:
+        from p2p_gossipprotocol_tpu.aligned import (frontier_capacity,
+                                                    halving_steps)
+        L_ex = sim.n_words * (topo.rows // ex_shards) * 128
+        K_ex = frontier_capacity(sim.frontier_threshold, L_ex)
+        steps = halving_steps(ex_shards)
+        gather_b = ex_shards * (2 * K_ex + 1) * 4
+        halving_b = ((1 + steps) * (2 * K_ex + 1) * 4
+                     if steps is not None else gather_b)
+        exchange = {
+            "exchange_shards": ex_shards,
+            "exchange_algo": ("halving" if sim._frontier_algo
+                              and steps is not None else "gather"),
+            "exchange_capacity_words": int(K_ex),
+            "exchange_halving_steps": (int(steps) if steps is not None
+                                       else None),
+            "gather_bytes_round": int(gather_b),
+            "halving_bytes_round": int(halving_b),
+        }
     # Steady-state per-round rate over a long free-running scan.  The
     # tunneled backend charges a ~70 ms CONSTANT per dispatched loop
     # program (measured: a trivial 6-iteration while_loop costs the
@@ -573,6 +620,7 @@ def _bench_aligned(n, n_msgs, degree, mode):
             "prefetch_depth": int(sim._prefetch),
             "frontier_mode": int(sim._frontier_delta),
             "frontier_threshold": round(sim.frontier_threshold, 8),
+            "frontier_algo": int(sim._frontier_algo),
             "overlap_mode": int(sim._overlap),
             **({"serve_chunk": serve["serve_chunk"]}
                if "serve_chunk" in serve else {}),
@@ -592,6 +640,7 @@ def _bench_aligned(n, n_msgs, degree, mode):
         **_roofline(bytes_round, rounds, wall),
         **({"prefetch_depth": prefetch_depth} if prefetch_depth else {}),
         **hier,
+        **exchange,
         **steady,
         **fleet,
         **serve,

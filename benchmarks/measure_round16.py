@@ -1,0 +1,283 @@
+"""Round-16 A/Bs: gather vs recursive-halving execution of the sparse
+frontier exchange (aligned._halving_allreduce, ISSUE 14).
+
+Three measurements, one JSON row each (plus a parity column on EVERY
+row — a byte saving with a different trajectory is not a result):
+
+* ``halving_sharded_ab``: the flat 8-shard exchange at 262k x W=2 —
+  the row reconstructs RECEIVED BYTES per chip per round from the
+  run's own fr_sparse/fr_halving diagnostics with the closed-form
+  exchange prices (tests/test_traffic_model.py pins the same
+  accounting: a gather round moves S tables of 2K+1 int32 per chip, a
+  halving round 1 + log2(S)) and reports the post-peak reduction
+  ratio, acceptance >= 2x.  parity additionally asserts the REGIME
+  series equal (fr_sparse/fr_words) — frontier_algo must never change
+  when the sparse regime runs, only how it moves.
+* ``halving_hier_ab``: the 2x4 two-tier variant — per-tier received
+  bytes (DCN at H=2 is the butterfly's degenerate equal-cost case,
+  ICI at D=4 drops 3 -> 2 column tables), both tiers' regime series
+  pinned equal.
+* ``budget_1b``: the ROADMAP item 4 re-quote — project_exchange's
+  closed-form 1B x 256 over 64x4 DCN budget under O(merged), gather
+  vs halving, no topology build.
+
+ms/round is recorded honestly: on interpret-mode CPU the butterfly's
+sort/merge work is expected to INVERT (the round-6/8/10/11 precedent —
+why frontier_algo's auto keys off interpret); the received-bytes
+reduction is the model-verified claim CPU rows can make, the wall-
+clock claim awaits the chip window.
+
+Run on the chip (watchdog chain step measure_round16):
+    PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/measure_round16.py
+Appends to GOSSIP_R16_OUT (default benchmarks/results/round16_tpu.jsonl
+on TPU, round16_cpu.jsonl elsewhere), resuming per-config like the
+round-4..15 drivers.  Scale knobs: GOSSIP_R16_PEERS (262144),
+GOSSIP_R16_ROUNDS (24), GOSSIP_R16_SHARDS (8).
+"""
+import json
+import os
+import sys
+import time
+
+# the sharded A/B needs a multi-device mesh; off-chip that means
+# virtual CPU devices, which must be requested BEFORE jax imports
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count="
+                               + os.environ.get("GOSSIP_R16_SHARDS", "8"))
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+OUT = None
+
+
+def _out_path(cpu: bool) -> str:
+    default = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "results",
+        "round16_cpu.jsonl" if cpu else "round16_tpu.jsonl")
+    return os.environ.get("GOSSIP_R16_OUT", default)
+
+
+def emit(row):
+    row["device"] = str(jax.devices()[0]).replace(" ", "_")
+    row["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    print(json.dumps(row), flush=True)
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def _landed() -> set:
+    from benchmarks._common import landed
+    return landed(OUT)
+
+
+def _series_equal(a, b) -> bool:
+    for k in ("coverage", "deliveries"):
+        if not np.array_equal(np.asarray(getattr(a, k)),
+                              np.asarray(getattr(b, k))):
+            return False
+    # the round-16 contract is stronger than round 8's: the REGIME
+    # series must match too (the algo changes execution, never regime)
+    for k in ("fr_sparse", "fr_words"):
+        if not np.array_equal(np.asarray(getattr(a, k)),
+                              np.asarray(getattr(b, k))):
+            return False
+    return bool(np.array_equal(
+        np.asarray(jax.device_get(a.state.seen_w)),
+        np.asarray(jax.device_get(b.state.seen_w))))
+
+
+def _mk_pair(n, n_msgs, shards, mesh_fn, hier_mode=-1):
+    from p2p_gossipprotocol_tpu.aligned import build_aligned
+    from p2p_gossipprotocol_tpu.liveness import ChurnConfig
+    from p2p_gossipprotocol_tpu.parallel import AlignedShardedSimulator
+
+    topo = build_aligned(seed=0, n=n, n_slots=16, degree_law="powerlaw",
+                         roll_groups=4, n_msgs=n_msgs, n_shards=shards)
+    kw = dict(topo=topo, n_msgs=n_msgs, mode="pushpull",
+              churn=ChurnConfig(rate=0.05, kill_round=1),
+              max_strikes=3, liveness_every=3, frontier_mode=1,
+              hier_mode=hier_mode, seed=0)
+    return (AlignedShardedSimulator(mesh=mesh_fn(), frontier_algo=0,
+                                    **kw),
+            AlignedShardedSimulator(mesh=mesh_fn(), frontier_algo=1,
+                                    **kw), topo)
+
+
+def _postpeak(per_round, words, sparse=None):
+    """Rounds after the frontier-width peak — and, with ``sparse``,
+    only those the shared regime series ran sparse: the steady tail a
+    real deployment sits in.  The hysteresis transient (post-peak
+    rounds still forced dense before the switch engages) belongs to
+    NEITHER execution — both move the same dense planes there — so
+    including it only measures how long the transient lasted, not the
+    algorithms (the round-8 windowing rule, one step further)."""
+    per_round = np.asarray(per_round)
+    peak = int(np.asarray(words).argmax())
+    post = np.arange(len(per_round)) > peak
+    if sparse is not None and (post & np.asarray(sparse)).any():
+        post &= np.asarray(sparse)
+    if not post.any():
+        post[-1] = True
+    return per_round[post]
+
+
+def bench_halving_sharded(n, rounds, shards, done):
+    """The flat A/B.  Runs past the coverage peak so the claim under
+    measurement is the steady sparse tail a real deployment sits in
+    (the round-8 windowing rule)."""
+    from p2p_gossipprotocol_tpu.aligned import (frontier_capacity,
+                                                halving_steps)
+    from p2p_gossipprotocol_tpu.parallel import make_mesh
+
+    if "halving_sharded_ab" in done:
+        return
+    shards = min(shards, len(jax.devices()))
+    n_msgs = int(os.environ.get("GOSSIP_R16_MSGS", "64"))   # W=2
+    gat, hal, topo = _mk_pair(n, n_msgs, shards,
+                              lambda: make_mesh(shards))
+    r_g = gat.run(rounds, warmup=True)
+    r_h = hal.run(rounds, warmup=True)
+    inner = hal._inner
+    W, R, C = inner.n_words, topo.rows, 128
+    wp = W * R * C * 4
+    L = W * (R // shards) * C
+    K = frontier_capacity(inner.frontier_threshold, L)
+    steps = halving_steps(shards)
+    g_tab = shards * (2 * K + 1) * 4
+    h_tab = (1 + steps) * (2 * K + 1) * 4
+    # received exchange bytes per chip per round, from each run's own
+    # execution diagnostics (dense rounds move the W frontier planes)
+    sparse_g = np.asarray(r_g.fr_sparse) != 0
+    per_g = np.where(sparse_g, g_tab, wp)
+    halv = np.asarray(r_h.fr_halving) != 0
+    per_h = np.where(halv, h_tab,
+                     np.where(np.asarray(r_h.fr_sparse) != 0, g_tab, wp))
+    post_g = _postpeak(per_g, r_g.fr_words, sparse_g)
+    post_h = _postpeak(per_h, r_h.fr_words, sparse_g)
+    reduction = float(post_g.mean()) / float(post_h.mean())
+    # the mixed window (dense transient included) reported next to it
+    # — both executions move the same planes on dense rounds, so this
+    # only dilutes toward 1x with the transient's length
+    mix_g = _postpeak(per_g, r_g.fr_words)
+    mix_h = _postpeak(per_h, r_h.fr_words)
+    emit({"config": "halving_sharded_ab", "n_peers": n, "rounds": rounds,
+          "n_msgs": n_msgs, "shards": shards,
+          "gather_ms_per_round": round(r_g.wall_s / rounds * 1e3, 2),
+          "halving_ms_per_round": round(r_h.wall_s / rounds * 1e3, 2),
+          "speedup": round(r_g.wall_s / r_h.wall_s, 3),
+          "capacity_words": int(K), "halving_steps": int(steps),
+          "gather_table_bytes": int(g_tab),
+          "halving_table_bytes": int(h_tab),
+          "postpeak_gather_bytes_round": int(post_g.mean()),
+          "postpeak_halving_bytes_round": int(post_h.mean()),
+          "postpeak_reduction_x": round(reduction, 2),
+          "postpeak_mixed_reduction_x": round(
+              float(mix_g.mean()) / float(mix_h.mean()), 2),
+          "halving_rounds": int(halv.sum()),
+          "sparse_rounds": int(sparse_g.sum()),
+          "parity_ok": _series_equal(r_g, r_h)})
+
+
+def bench_halving_hier(n, rounds, done):
+    """The 2x4 two-tier variant: each tier's butterfly independently,
+    per-tier received bytes from per-tier diagnostics."""
+    from p2p_gossipprotocol_tpu.aligned import (frontier_capacity,
+                                                halving_steps)
+    from p2p_gossipprotocol_tpu.parallel import make_hier_mesh
+
+    if "halving_hier_ab" in done or len(jax.devices()) < 8:
+        return
+    H, D = 2, 4
+    n_msgs = int(os.environ.get("GOSSIP_R16_MSGS", "64"))
+    gat, hal, topo = _mk_pair(n, n_msgs, H * D,
+                              lambda: make_hier_mesh(H, D), hier_mode=1)
+    r_g = gat.run(rounds, warmup=True)
+    r_h = hal.run(rounds, warmup=True)
+    inner = hal._inner
+    W, R, C = inner.n_words, topo.rows, 128
+    L = W * (R // (H * D)) * C
+    K = frontier_capacity(inner.frontier_threshold, L)
+    Kc = frontier_capacity(inner.frontier_threshold, L * H)
+    dcn_g, dcn_h = (H - 1) * (2 * K + 1) * 4, \
+        halving_steps(H) * (2 * K + 1) * 4
+    ici_g, ici_h = (D - 1) * (2 * Kc + 1) * 4, \
+        halving_steps(D) * (2 * Kc + 1) * 4
+    halv_d = np.asarray(r_h.fr_halving) != 0
+    halv_i = np.asarray(r_h.fr_halving_ici) != 0
+    sp_d = np.asarray(r_h.fr_sparse) != 0
+    sp_i = np.asarray(r_h.fr_sparse_ici) != 0
+    per_h = (np.where(halv_d, dcn_h, np.where(sp_d, dcn_g, (H - 1) * L * 4))
+             + np.where(halv_i, ici_h,
+                        np.where(sp_i, ici_g, (D - 1) * H * L * 4)))
+    per_g = (np.where(sp_d, dcn_g, (H - 1) * L * 4)
+             + np.where(sp_i, ici_g, (D - 1) * H * L * 4))
+    post_g = _postpeak(per_g, r_g.fr_words, sp_d & sp_i)
+    post_h = _postpeak(per_h, r_h.fr_words, sp_d & sp_i)
+    parity = _series_equal(r_g, r_h) and np.array_equal(
+        np.asarray(r_g.fr_sparse_ici), np.asarray(r_h.fr_sparse_ici))
+    emit({"config": "halving_hier_ab", "n_peers": n, "rounds": rounds,
+          "n_msgs": n_msgs, "hier": f"{H}x{D}",
+          "gather_ms_per_round": round(r_g.wall_s / rounds * 1e3, 2),
+          "halving_ms_per_round": round(r_h.wall_s / rounds * 1e3, 2),
+          "dcn_table_bytes_gather": int(dcn_g),
+          "dcn_table_bytes_halving": int(dcn_h),
+          "ici_table_bytes_gather": int(ici_g),
+          "ici_table_bytes_halving": int(ici_h),
+          "postpeak_gather_bytes_round": int(post_g.mean()),
+          "postpeak_halving_bytes_round": int(post_h.mean()),
+          "postpeak_reduction_x": round(
+              float(post_g.mean()) / float(post_h.mean()), 2),
+          "halving_rounds_dcn": int(halv_d.sum()),
+          "halving_rounds_ici": int(halv_i.sum()),
+          "parity_ok": bool(parity)})
+
+
+def bench_budget_1b(done):
+    """The ROADMAP item 4 re-quote, closed form: 1B x 256 over 64
+    hosts x 4 devs, post-peak fill, fused path — DCN GB/round gather
+    vs halving."""
+    from p2p_gossipprotocol_tpu.aligned import project_exchange
+
+    if "budget_1b" in done:
+        return
+    kw = dict(n_peers=1 << 30, n_msgs=256, n_shards=256, n_hosts=64,
+              frontier_fill=0.0001, fused=True)
+    g = project_exchange(algo=0, **kw)
+    h = project_exchange(algo=1, **kw)
+    emit({"config": "budget_1b", "n_peers": 1 << 30, "n_msgs": 256,
+          "mesh": "64x4", "frontier_fill": 0.0001,
+          "dcn_gb_gather": round(g["dcn_gather"] / 1e9, 6),
+          "dcn_gb_halving": round(h["dcn_gather"] / 1e9, 6),
+          "ici_gb_gather": round(g["ici_gather"] / 1e9, 6),
+          "ici_gb_halving": round(h["ici_gather"] / 1e9, 6),
+          "dcn_reduction_x": round(g["dcn_gather"] / h["dcn_gather"], 1),
+          "parity_ok": True})
+
+
+def main():
+    global OUT
+    backend = jax.default_backend()
+    on_tpu = backend in ("tpu", "axon")
+    OUT = _out_path(cpu=not on_tpu)
+    n = int(os.environ.get("GOSSIP_R16_PEERS", str(1 << 18)))
+    rounds = int(os.environ.get("GOSSIP_R16_ROUNDS", "24"))
+    shards = int(os.environ.get("GOSSIP_R16_SHARDS", "8"))
+    done = _landed()
+    if "_backend" not in done:
+        emit({"config": "_backend", "backend": backend, "n_peers": n,
+              "rounds": rounds, "parity_ok": True})
+    bench_halving_sharded(n, rounds, shards, done)
+    bench_halving_hier(n, rounds, done)
+    bench_budget_1b(done)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

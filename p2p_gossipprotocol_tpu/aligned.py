@@ -500,7 +500,7 @@ def project_exchange(n_peers: int, n_msgs: int, n_shards: int,
                      n_hosts: int = 0, frontier_fill: float = 1.0,
                      threshold: float = FRONTIER_THRESHOLD_DEFAULT,
                      fused: bool = False,
-                     rows: int | None = None) -> dict:
+                     rows: int | None = None, algo: int = 0) -> dict:
     """Closed-form per-chip interconnect bytes of one round's frontier
     exchange — NO topology needed, so it projects scales no host can
     build (the 1B-peer per-tier byte budget ROADMAP item 1 asks for).
@@ -522,7 +522,19 @@ def project_exchange(n_peers: int, n_msgs: int, n_shards: int,
     same physical layout — ``S-D`` remote tables, the D-fold
     redundant delivery the hierarchy deletes — so
     ``flat_dcn / dcn_gather`` is the round-11 A/B's headline ratio
-    (~D post-peak)."""
+    (~D post-peak).
+
+    Sparse allreduce (round 16, ``algo=1``): each tier that can run
+    the recursive-halving butterfly (power-of-two member count M >= 2)
+    is priced per its real execution — when the merged table fits the
+    tier's capacity (changed-word total over its members <= K), the
+    chip receives ``log2(M)`` tables of ``2K+1`` int32 instead of the
+    gather's M (the flat closed form keeps the self-table base term,
+    so M=1 degenerates bit-for-bit to the gather pricing); an
+    over-total fill is priced at the gather fallback the runtime
+    executes.  ``halving_exchange``/``gather_exchange`` report both
+    quotes side by side (the measure_round16 A/B's ratio);
+    ``delta_gather`` charges whichever ``algo`` selects."""
     C = LANES
     R = rows if rows is not None else -(-n_peers // C)
     W = n_msg_words(n_msgs)
@@ -535,12 +547,37 @@ def project_exchange(n_peers: int, n_msgs: int, n_shards: int,
     wp, plane = W * R * C * 4, R * C * 4
     hier = (n_hosts and n_hosts > 1 and n_shards % n_hosts == 0
             and n_hosts < n_shards)
+    def tier_halving(m: int, cap: int, tier_total: int, gather_b: int,
+                     base: int) -> int:
+        # one tier's halving-execution price at this fill (callers
+        # invoke it only inside the tier's sparse regime): log2(m)
+        # merged tables when the tier's merged total fits its capacity
+        # (+ ``base`` self-table terms, the flat form's M=1 degeneracy
+        # anchor), else exactly the gather fallback the runtime takes
+        steps = halving_steps(m)
+        if m < 2 or steps is None:
+            return gather_b                      # structural fallback
+        if tier_total <= cap:
+            return (base + steps) * (2 * cap + 1) * 4
+        return gather_b
+
     if not hier:
-        ici = n_shards * (2 * K + 1) * 4 if sparse else wp
+        gx = n_shards * (2 * K + 1) * 4 if sparse else wp
+        if sparse:
+            hx = tier_halving(n_shards, K, changed * n_shards, gx,
+                              base=1)
+        else:
+            hx = wp                               # forced dense
         if not fused:
-            ici += plane
-        return {"delta_gather": ici, "ici_gather": ici,
-                "dcn_gather": 0, "flat_dcn": 0, "capacity_words": K}
+            gx += plane
+            hx += plane
+        delta = hx if algo else gx
+        out = {"delta_gather": delta, "ici_gather": delta,
+               "dcn_gather": 0, "flat_dcn": 0, "capacity_words": K}
+        if algo:
+            out["halving_exchange"] = hx
+            out["gather_exchange"] = gx
+        return out
     D = n_shards // n_hosts
     Kc = frontier_capacity(threshold, L * n_hosts)   # ICI column table
     sparse_i = changed * n_hosts <= Kc
@@ -548,6 +585,13 @@ def project_exchange(n_peers: int, n_msgs: int, n_shards: int,
            else (n_hosts - 1) * L * 4)
     ici = ((D - 1) * (2 * Kc + 1) * 4 if sparse_i
            else (D - 1) * n_hosts * L * 4)
+    # per-tier halving quotes: the DCN merge assembles one column table
+    # (total = H x per-device changed), the ICI merge the global one
+    # (total = S x changed); each tier falls back independently
+    dcn_h = (tier_halving(n_hosts, K, changed * n_hosts, dcn, base=0)
+             if sparse else (n_hosts - 1) * L * 4)
+    ici_h = (tier_halving(D, Kc, changed * n_shards, ici, base=0)
+             if sparse_i else (D - 1) * n_hosts * L * 4)
     flat_dcn = ((n_shards - D) * (2 * K + 1) * 4 if sparse
                 else (n_shards - D) * L * 4)
     if not fused:
@@ -555,11 +599,37 @@ def project_exchange(n_peers: int, n_msgs: int, n_shards: int,
         # slice per remote host over DCN, the column re-broadcast
         # over ICI (flat: one slice per remote chip crosses DCN)
         dcn += (n_hosts - 1) * sl
+        dcn_h += (n_hosts - 1) * sl
         ici += (D - 1) * n_hosts * sl
+        ici_h += (D - 1) * n_hosts * sl
         flat_dcn += (n_shards - D) * sl
-    return {"delta_gather": dcn + ici, "ici_gather": ici,
-            "dcn_gather": dcn, "flat_dcn": flat_dcn,
-            "capacity_words": K, "capacity_words_ici": Kc}
+    out = {"delta_gather": (dcn_h + ici_h) if algo else (dcn + ici),
+           "ici_gather": ici_h if algo else ici,
+           "dcn_gather": dcn_h if algo else dcn, "flat_dcn": flat_dcn,
+           "capacity_words": K, "capacity_words_ici": Kc}
+    if algo:
+        out["halving_exchange"] = dcn_h + ici_h
+        out["gather_exchange"] = dcn + ici
+    return out
+
+
+def _compact_table(planes: jax.Array, changed: jax.Array, K: int,
+                   gidx: jax.Array):
+    """Compact one member's changed words into a static ``K``-word
+    ``(index, value)`` table pair — THE compaction both sparse
+    executions share (the gather moves whole tables, the halving
+    butterfly merges them pairwise).  Changed word j lands at slot
+    pos[j] (< K on the caller's cond branch — its predicate guarantees
+    the fit); unchanged words ADD zero at slot 0, which no real word
+    can lose to."""
+    flat = planes.reshape(-1)
+    pos = jnp.cumsum(changed, dtype=jnp.int32) - 1
+    tgt = jnp.where(changed, jnp.minimum(pos, K - 1), 0)
+    vals = jnp.zeros(K, jnp.int32).at[tgt].add(
+        jnp.where(changed, flat, 0))
+    idxs = jnp.zeros(K, jnp.int32).at[tgt].add(
+        jnp.where(changed, gidx, 0))
+    return idxs, vals
 
 
 def _sparse_gather(planes: jax.Array, changed: jax.Array,
@@ -570,17 +640,9 @@ def _sparse_gather(planes: jax.Array, changed: jax.Array,
     all-gather the tables over ``axis``, scatter-ADD into zeros of
     ``out_words`` int32.  Exact: deltas are bit-disjoint from zeros and
     every output word has exactly one owner member (``gidx`` is a
-    member-disjoint map into the output space); changed word j lands at
-    slot pos[j] (< K on the caller's cond branch — its predicate
-    guarantees the fit); unchanged words ADD zero at slot 0, which no
-    real word can lose to; invalid gathered slots add 0."""
-    flat = planes.reshape(-1)
-    pos = jnp.cumsum(changed, dtype=jnp.int32) - 1
-    tgt = jnp.where(changed, jnp.minimum(pos, K - 1), 0)
-    vals = jnp.zeros(K, jnp.int32).at[tgt].add(
-        jnp.where(changed, flat, 0))
-    idxs = jnp.zeros(K, jnp.int32).at[tgt].add(
-        jnp.where(changed, gidx, 0))
+    member-disjoint map into the output space); invalid gathered slots
+    add 0."""
+    idxs, vals = _compact_table(planes, changed, K, gidx)
     idx_g = jax.lax.all_gather(idxs, axis)          # [M, K]
     val_g = jax.lax.all_gather(vals, axis)          # [M, K]
     cnt_g = jax.lax.all_gather(n_changed, axis)     # [M]
@@ -588,6 +650,100 @@ def _sparse_gather(planes: jax.Array, changed: jax.Array,
     return jnp.zeros(out_words, jnp.int32).at[
         jnp.where(valid, idx_g, 0).reshape(-1)].add(
         jnp.where(valid, val_g, 0).reshape(-1))
+
+
+#: sort sentinel for invalid table slots — larger than any global word
+#: id, so the merge's sort pushes padding past every real entry
+_MERGE_SENTINEL = (1 << 31) - 1
+
+
+def halving_steps(m: int) -> int | None:
+    """``log2(m)`` when ``m`` is a power of two >= 1, else None — the
+    recursive-halving butterfly pairs member ``i`` with ``i ^ 2^s`` at
+    step ``s``, which only tiles a power-of-two member count.  Callers
+    treat None as "this tier executes its sparse regime by gather"
+    (recorded at resolution time: aligned.from_config clamps an
+    explicit ``frontier_algo=1`` on a non-power-of-two axis)."""
+    if m >= 1 and (m & (m - 1)) == 0:
+        return m.bit_length() - 1
+    return None
+
+
+def _merge_tables(idx_a, val_a, cnt_a, idx_b, val_b, cnt_b, K: int):
+    """Sorted-index merge of two compacted ``(index, word)`` tables
+    under the shared static capacity ``K`` — one butterfly step's
+    reduction.  Invalid slots (>= each table's count) sort to the end
+    behind the ``_MERGE_SENTINEL`` key; duplicate indices OR-combine
+    (adjacent after the sort; each index appears at most once per input
+    table, so runs are length <= 2 and one neighbor combine is exact —
+    in this engine duplicates never occur at all, every global word
+    having exactly one owner shard, but the OR keeps the reduction
+    idempotent-exact on its own terms).  Returns ``(idx, val, count)``
+    with the merged entries compacted to the front; the caller's fit
+    predicate (merged total <= K) guarantees ``count <= K``, and the
+    traced non-fit branch clamps instead of corrupting."""
+    slot = jnp.arange(K, dtype=jnp.int32)
+    keys = jnp.concatenate([
+        jnp.where(slot < cnt_a, idx_a, _MERGE_SENTINEL),
+        jnp.where(slot < cnt_b, idx_b, _MERGE_SENTINEL)])
+    vals = jnp.concatenate([jnp.where(slot < cnt_a, val_a, 0),
+                            jnp.where(slot < cnt_b, val_b, 0)])
+    keys, vals = jax.lax.sort_key_val(keys, vals)
+    dup = keys[1:] == keys[:-1]                     # [2K-1]
+    nxt = jnp.concatenate([dup, jnp.zeros(1, bool)])
+    combined = jnp.where(
+        nxt, vals | jnp.concatenate([vals[1:], jnp.zeros(1, jnp.int32)]),
+        vals)
+    keep = (keys != _MERGE_SENTINEL) \
+        & jnp.concatenate([jnp.ones(1, bool), ~dup])
+    pos = jnp.cumsum(keep, dtype=jnp.int32) - 1
+    tgt = jnp.where(keep, jnp.minimum(pos, K - 1), 0)
+    out_i = jnp.zeros(K, jnp.int32).at[tgt].add(jnp.where(keep, keys, 0))
+    out_v = jnp.zeros(K, jnp.int32).at[tgt].add(
+        jnp.where(keep, combined, 0))
+    return out_i, out_v, jnp.sum(keep, dtype=jnp.int32)
+
+
+def _halving_allreduce(planes: jax.Array, changed: jax.Array,
+                       n_changed: jax.Array, axis, M: int, K: int,
+                       gidx: jax.Array, out_words: int):
+    """One tier's sparse allreduce by recursive halving (arXiv:1312.3020
+    adapted to the frontier's single-owner tables): compact this
+    member's changed words into a static ``K``-word table, then run
+    ``log2(M)`` pairwise ``lax.ppermute`` exchanges — step ``s`` pairs
+    member ``i`` with ``i ^ 2^s`` and sorted-index-merges the received
+    table into the local one, halving the number of unmerged table
+    groups each step — so after the last step EVERY member holds the
+    fully merged frontier table, scatter-ADDed into zeros exactly like
+    the gather path (same compaction, same scatter, bitwise the same
+    planes).
+
+    The per-step capacity rule: every partial merge is bounded by the
+    MERGED total, so one static capacity ``K`` (the same
+    ``frontier_capacity`` the gather path sizes per member) serves all
+    steps, and the caller pre-checks the exact fit (global changed-word
+    total <= K, a scalar psum) before taking this branch.  Received
+    bytes per chip: ``log2(M)`` tables of ``2K+1`` int32 — O(merged
+    capacity x log M) against the gather's O(M x K) sum-of-tables.
+
+    Two-phase reduce-scatter + allgather would add nothing here: each
+    global word has exactly one owner shard, so an index-space-halving
+    reduction has only empty messages (every member's table already IS
+    the merged table restricted to its own region) — the butterfly
+    above is the redistribution phase with the merge folded in, half
+    the steps of the textbook pair."""
+    idx, val = _compact_table(planes, changed, K, gidx)
+    cnt = n_changed
+    for s in range(halving_steps(M)):
+        pairs = [(i, i ^ (1 << s)) for i in range(M)]
+        msg = jnp.concatenate([idx, val, cnt[None]])
+        got = jax.lax.ppermute(msg, axis, pairs)
+        idx, val, cnt = _merge_tables(idx, val, cnt,
+                                      got[:K], got[K:2 * K], got[2 * K],
+                                      K)
+    valid = jnp.arange(K, dtype=jnp.int32) < cnt
+    return jnp.zeros(out_words, jnp.int32).at[
+        jnp.where(valid, idx, 0)].add(jnp.where(valid, val, 0))
 
 
 def _hier_gather(x: jax.Array, dcn_axis: str, ici_axis: str,
@@ -644,6 +800,26 @@ def _frontier_exchange(sim, frontier_l: jax.Array, fr: FrontierCarry,
     the FLAT exchange — hier_mode resolved off): the gathers and the
     member index generalize unchanged.
 
+    SPARSE ALLREDUCE (round 16, ``sim._frontier_algo``): HOW the sparse
+    regime executes is itself a two-way static — the all-gather of the
+    K-word tables above (every chip receives all M tables, O(sum of
+    capacities)), or the recursive-halving butterfly
+    (:func:`_halving_allreduce`): log2(M) ``ppermute`` pairwise
+    exchanges that sorted-index-merge the compacted tables, so each
+    chip receives log2(M) tables instead of M.  The halving table must
+    hold the MERGED frontier under the same static capacity K, so the
+    branch engages only when the exact global census fits (total
+    changed words <= K, pre-checked by a scalar psum made mesh-uniform
+    like ``worst``); a sparse round whose merged total overflows falls
+    back to the gather execution INSIDE the sparse regime — the
+    regime predicate, the hysteresis, and the fr_sparse/fr_words
+    series stay bit-for-bit the gather path's, which is what keeps
+    "every metric" in the bitwise contract.  Over-capacity frontiers
+    still force dense exactly like today (worst > K — the shared
+    capacity rule).  Non-power-of-two member counts and multi-axis
+    flat exchanges (a hier mesh running flat) keep the gather
+    structurally (``halving_steps``; recorded at resolution time).
+
     HIERARCHICAL path (``ici_axis`` set, round 11): the exchange runs
     per TIER.  Tier 1 (DCN, ``axis`` = the host axis): each device
     exchanges its OWN row slice with its column group across hosts —
@@ -662,8 +838,13 @@ def _frontier_exchange(sim, frontier_l: jax.Array, fr: FrontierCarry,
     (``fr.regime_ici``) scattering straight into global order.  Every
     regime combination is bitwise the flat gather (tests/test_hier.py).
 
-    Returns ``(F_global, fr', went_sparse, worst_words, went_ici)``
-    (``went_ici`` None on the flat path)."""
+    Returns ``(F_global, fr', went_sparse, worst_words, went_ici,
+    went_halving, went_halving_ici)`` (``went_ici``/``went_halving_ici``
+    None on the flat path; the went_halving flags are DIAGNOSTICS of
+    which execution moved the bytes — like fr_sparse they ride the
+    metric history for the A/B's received-byte reconstruction, but
+    unlike fr_sparse they differ between algo runs by design and are
+    never part of the parity surface)."""
     W_l, Rl, C = frontier_l.shape
     Rg = Rl * n_shards
     L = W_l * Rl * C
@@ -674,29 +855,58 @@ def _frontier_exchange(sim, frontier_l: jax.Array, fr: FrontierCarry,
     for ax in pmax_axes:
         worst = jax.lax.pmax(worst, ax)
     i = jnp.arange(L, dtype=jnp.int32)
+    algo = bool(getattr(sim, "_frontier_algo", False))
 
     if ici_axis is None:
+        # the halving butterfly needs ONE named axis to ppermute over
+        # (a hier mesh running the flat exchange gathers over the axis
+        # PAIR) and a power-of-two member count >= 2
+        use_h = (algo and not isinstance(axis, (tuple, list))
+                 and n_shards >= 2
+                 and halving_steps(n_shards) is not None)
         grow0 = jax.lax.axis_index(axis) * Rl
+        # global word id of local word i: plane-major, global rows
+        g_i = (i // (Rl * C)) * (Rg * C) + grow0 * C + i % (Rl * C)
 
         def dense(_):
             return jax.lax.all_gather(frontier_l, axis, axis=1,
                                       tiled=True)
 
-        def sparse(_):
-            # global word id of local word i: plane-major, global rows
-            g_i = (i // (Rl * C)) * (Rg * C) + grow0 * C + i % (Rl * C)
+        def by_gather(_):
             return _sparse_gather(frontier_l, changed, n_changed, axis,
                                   K, g_i, W_l * Rg * C
                                   ).reshape(W_l, Rg, C)
 
         went_sparse = (fr.regime == 1) & (worst <= K)
+        if use_h:
+            # exact fit of the MERGED table: the global changed-word
+            # total (scalar psum, pmax-uniform so every device takes
+            # the same branch of the nested conditional)
+            total = jax.lax.psum(n_changed, axis)
+            for ax in pmax_axes:
+                total = jax.lax.pmax(total, ax)
+            fits_h = total <= K
+
+            def by_halving(_):
+                return _halving_allreduce(
+                    frontier_l, changed, n_changed, axis, n_shards, K,
+                    g_i, W_l * Rg * C).reshape(W_l, Rg, C)
+
+            def sparse(_):
+                return jax.lax.cond(fits_h, by_halving, by_gather, None)
+
+            went_halving = (went_sparse & fits_h).astype(jnp.int32)
+        else:
+            sparse = by_gather
+            went_halving = jnp.int32(0)
         F = jax.lax.cond(went_sparse, sparse, dense, None)
         regime2 = jnp.where(fr.regime == 1, worst <= K,
                             worst <= K // 2).astype(jnp.int32)
         replica2 = None if fr.replica_w is None else fr.replica_w | F
         return (F, FrontierCarry(replica_w=replica2, byz_g=fr.byz_g,
                                  regime=regime2),
-                went_sparse.astype(jnp.int32), worst, None)
+                went_sparse.astype(jnp.int32), worst, None,
+                went_halving, None)
 
     # ---- hierarchical two-tier exchange -----------------------------
     D = n_shards // n_hosts
@@ -705,6 +915,11 @@ def _frontier_exchange(sim, frontier_l: jax.Array, fr: FrontierCarry,
     K_i = frontier_capacity(sim.frontier_threshold, Lc)
     h = jax.lax.axis_index(axis)
     d = jax.lax.axis_index(ici_axis)
+    # each tier takes the halving variant independently — its own
+    # member count, its own power-of-two legality
+    use_h_dcn = (algo and n_hosts >= 2
+                 and halving_steps(n_hosts) is not None)
+    use_h_ici = (algo and D >= 2 and halving_steps(D) is not None)
     # ICI-tier census: this COLUMN's total changed words (its table is
     # the union of one slice per host), made uniform across the mesh
     # like ``worst`` so every device takes the same cond branch
@@ -712,17 +927,34 @@ def _frontier_exchange(sim, frontier_l: jax.Array, fr: FrontierCarry,
     worst_col = col
     for ax in pmax_axes:
         worst_col = jax.lax.pmax(worst_col, ax)
+    # word id inside the COLUMN table [W_l, H*Rl, C], host-major
+    g_i = (i // (Rl * C)) * (Rc * C) + h * Rl * C + i % (Rl * C)
 
     def dcn_dense(_):
         return jax.lax.all_gather(frontier_l, axis, axis=1, tiled=True)
 
-    def dcn_sparse(_):
-        # word id inside the COLUMN table [W_l, H*Rl, C], host-major
-        g_i = (i // (Rl * C)) * (Rc * C) + h * Rl * C + i % (Rl * C)
+    def dcn_gather(_):
         return _sparse_gather(frontier_l, changed, n_changed, axis,
                               K, g_i, W_l * Rc * C).reshape(W_l, Rc, C)
 
     went_dcn = (fr.regime == 1) & (worst <= K)
+    if use_h_dcn:
+        # the DCN merge assembles one COLUMN table: its exact total is
+        # the ICI census above, already pmax-uniform
+        fits_dcn = worst_col <= K
+
+        def dcn_halving(_):
+            return _halving_allreduce(
+                frontier_l, changed, n_changed, axis, n_hosts, K, g_i,
+                W_l * Rc * C).reshape(W_l, Rc, C)
+
+        def dcn_sparse(_):
+            return jax.lax.cond(fits_dcn, dcn_halving, dcn_gather, None)
+
+        went_halving = (went_dcn & fits_dcn).astype(jnp.int32)
+    else:
+        dcn_sparse = dcn_gather
+        went_halving = jnp.int32(0)
     F_col = jax.lax.cond(went_dcn, dcn_sparse, dcn_dense, None)
     regime2 = jnp.where(fr.regime == 1, worst <= K,
                         worst <= K // 2).astype(jnp.int32)
@@ -736,20 +968,41 @@ def _frontier_exchange(sim, frontier_l: jax.Array, fr: FrontierCarry,
         # (d, h)-ordered blocks -> global (h, d) row order
         return jnp.transpose(g2, (1, 2, 0, 3, 4)).reshape(W_l, Rg, C)
 
-    def ici_sparse(_):
-        # word id in the GLOBAL planes: column word (w, h*Rl + r, c)
-        # lives at global row (h*D + d)*Rl + r
-        j = jnp.arange(Lc, dtype=jnp.int32)
-        w = j // (Rc * C)
-        rem = j % (Rc * C)
-        r_col, c = rem // C, rem % C
-        hh, r = r_col // Rl, r_col % Rl
-        g_j = w * (Rg * C) + ((hh * D + d) * Rl + r) * C + c
+    # word id in the GLOBAL planes: column word (w, h*Rl + r, c)
+    # lives at global row (h*D + d)*Rl + r
+    j = jnp.arange(Lc, dtype=jnp.int32)
+    w = j // (Rc * C)
+    rem = j % (Rc * C)
+    r_col, c = rem // C, rem % C
+    hh, r = r_col // Rl, r_col % Rl
+    g_j = w * (Rg * C) + ((hh * D + d) * Rl + r) * C + c
+
+    def ici_gather(_):
         return _sparse_gather(F_col, changed_c, n_changed_c, ici_axis,
                               K_i, g_j, W_l * Rg * C
                               ).reshape(W_l, Rg, C)
 
     went_ici = (fr.regime_ici == 1) & (worst_col <= K_i)
+    if use_h_ici:
+        # the ICI merge assembles the GLOBAL frontier table: its exact
+        # total is the global census (psum over both tiers)
+        total_g = jax.lax.psum(col, ici_axis)
+        for ax in pmax_axes:
+            total_g = jax.lax.pmax(total_g, ax)
+        fits_ici = total_g <= K_i
+
+        def ici_halving(_):
+            return _halving_allreduce(
+                F_col, changed_c, n_changed_c, ici_axis, D, K_i, g_j,
+                W_l * Rg * C).reshape(W_l, Rg, C)
+
+        def ici_sparse(_):
+            return jax.lax.cond(fits_ici, ici_halving, ici_gather, None)
+
+        went_halving_ici = (went_ici & fits_ici).astype(jnp.int32)
+    else:
+        ici_sparse = ici_gather
+        went_halving_ici = jnp.int32(0)
     F = jax.lax.cond(went_ici, ici_sparse, ici_dense, None)
     regime_i2 = jnp.where(fr.regime_ici == 1, worst_col <= K_i,
                           worst_col <= K_i // 2).astype(jnp.int32)
@@ -757,7 +1010,7 @@ def _frontier_exchange(sim, frontier_l: jax.Array, fr: FrontierCarry,
     return (F, FrontierCarry(replica_w=replica2, byz_g=fr.byz_g,
                              regime=regime2, regime_ici=regime_i2),
             went_dcn.astype(jnp.int32), worst,
-            went_ici.astype(jnp.int32))
+            went_ici.astype(jnp.int32), went_halving, went_halving_ici)
 
 
 def _skip_plan(y: jax.Array, rowblk: int, t_local: int,
@@ -991,6 +1244,19 @@ class AlignedSimulator:
     #: sparse-exchange capacity per shard as a fraction of its packed
     #: words (FRONTIER_THRESHOLD_DEFAULT has the derivation).
     frontier_threshold: float = FRONTIER_THRESHOLD_DEFAULT
+    #: HOW the sparse regime executes its exchange (round 16): 0 = the
+    #: round-8 table all-gather, 1 = the recursive-halving sparse
+    #: allreduce (log2(M) ppermute pairwise merges — each chip receives
+    #: O(merged capacity x log M) bytes instead of O(M x K)), -1 auto
+    #: (halving on the compiled path, gather under interpret — the
+    #: butterfly's sort/merge work inverts on CPU, the round-6/8/10/11
+    #: precedent).  A third way to EXECUTE the same regime: the regime
+    #: predicate, hysteresis, and every metric are bitwise the gather
+    #: path's (rounds whose merged total overflows the capacity fall
+    #: back to the gather inside the sparse branch; non-power-of-two
+    #: axes keep the gather structurally, recorded at resolution).
+    #: Excluded from checkpoint fingerprints like every frontier_* key.
+    frontier_algo: int = 0
     #: double-buffered DMA pipelining of the gossip kernels' y stream
     #: (round 10): -1 auto (2 on the compiled TPU path, 0 under
     #: interpret — the manual copy stream only adds interpreter work on
@@ -1153,6 +1419,15 @@ class AlignedSimulator:
                                             self.interpret)
         self._frontier_skip = fr_on and self.mode in ("push", "pushpull")
         self._frontier_delta = fr_on
+        # Sparse-allreduce execution of the delta exchange (round 16):
+        # resolved here like frontier_mode; the per-tier power-of-two
+        # legality is structural (_frontier_exchange / halving_steps),
+        # so the resolved flag means "halving wherever the mesh can".
+        if self.frontier_algo not in (-1, 0, 1):
+            raise ValueError("frontier_algo must be -1 (auto), 0 "
+                             "(gather), or 1 (halving)")
+        self._frontier_algo = tuning_resolve.heuristic_on(
+            self.frontier_algo, self.interpret)
         # Round-10 schedule knobs (both bitwise-identical, both keyed
         # off interpret on auto like frontier_mode): the manual
         # double-buffered DMA stream, and the self/remote split that
@@ -1302,6 +1577,22 @@ class AlignedSimulator:
             clamps.append(
                 "frontier_mode 1 with mode=pull -> delta exchange only "
                 "(pure pull has no push pass to block-skip)")
+        # Sparse-allreduce execution (round 16): the halving butterfly
+        # tiles power-of-two member counts only — an explicit request
+        # on an axis it cannot tile is recorded (the exchange then
+        # keeps the gather structurally, same values either way).
+        if cfg.frontier_algo == 1:
+            hh_req, hd_req = resolve_hier(cfg.hier_hosts, cfg.hier_devs,
+                                          n_shards, None)
+            tiers = ((hh_req, hd_req) if hh_req else (n_shards,))
+            bad = [m for m in tiers
+                   if m > 1 and halving_steps(m) is None]
+            if bad:
+                clamps.append(
+                    f"frontier_algo 1 on a non-power-of-two axis "
+                    f"({'x'.join(str(m) for m in tiers)} members) -> "
+                    "gather execution on that tier (the recursive-"
+                    "halving butterfly pairs i with i^2^s)")
         # Round-10 schedule knobs: both bitwise-identical, so explicit
         # values are always SAFE; a combination where the feature
         # cannot exist is recorded, never silent (frontier precedent).
@@ -1367,6 +1658,7 @@ class AlignedSimulator:
             requested={
                 "frontier_mode": cfg.frontier_mode,
                 "frontier_threshold": cfg.frontier_threshold,
+                "frontier_algo": cfg.frontier_algo,
                 "prefetch_depth": cfg.prefetch_depth,
                 "overlap_mode": cfg.overlap_mode,
                 "hier_mode": cfg.hier_mode,
@@ -1377,6 +1669,8 @@ class AlignedSimulator:
                 "frontier_threshold":
                     tuning_resolve.heuristic_frontier_threshold(
                         cfg.frontier_threshold),
+                "frontier_algo": int(tuning_resolve.heuristic_on(
+                    cfg.frontier_algo, interpret)),
                 "prefetch_depth": tuning_resolve.heuristic_prefetch(
                     cfg.prefetch_depth, interpret),
                 "overlap_mode": int(tuning_resolve.heuristic_on(
@@ -1389,6 +1683,10 @@ class AlignedSimulator:
                 "frontier_threshold":
                     lambda v: isinstance(v, (int, float))
                     and 0.0 < v <= 1.0,
+                # bitwise either way; non-power-of-two tiers degrade
+                # structurally inside the exchange, so any cached 0/1
+                # is legal on any mesh
+                "frontier_algo": lambda v: v in (0, 1),
                 "prefetch_depth": lambda v: v in (0, 2),
                 # the self/remote split needs the block-perm overlay's
                 # block-granular locality and a push pass — the same
@@ -1422,6 +1720,7 @@ class AlignedSimulator:
                           else None),
                   frontier_mode=int(st["frontier_mode"]),
                   frontier_threshold=float(st["frontier_threshold"]),
+                  frontier_algo=int(st["frontier_algo"]),
                   prefetch_depth=int(st["prefetch_depth"]),
                   overlap_mode=int(st["overlap_mode"]),
                   hier_hosts=hier_hosts, hier_devs=hier_devs,
@@ -1592,6 +1891,7 @@ class AlignedSimulator:
                                       + plan["row"] * blk * C + 2 * wp)
         hidden = None
         tier = None
+        halving = None
         if n_shards > 1 and self._frontier_delta:
             # interconnect bytes of the exchange, per chip per round
             # (the measure_round8/11 A/Bs' gathered-bytes columns):
@@ -1610,9 +1910,11 @@ class AlignedSimulator:
                 n_peers=R * C, n_msgs=self.n_msgs, n_shards=n_shards,
                 n_hosts=nh, frontier_fill=fill,
                 threshold=self.frontier_threshold, fused=fused,
-                rows=R)
+                rows=R, algo=int(self._frontier_algo))
             delta = ex["delta_gather"]
             tier = (ex["ici_gather"], ex["dcn_gather"])
+            halving = (ex.get("halving_exchange"),
+                       ex.get("gather_exchange"))
             if overlap:
                 # the split moves the exchange off the critical path:
                 # its bytes land in ``overlap_hidden`` (reported,
@@ -1635,6 +1937,12 @@ class AlignedSimulator:
             # to it, never double-charged into ``total``
             terms["ici_gather"] = int(tier[0])
             terms["dcn_gather"] = int(tier[1])
+        if halving is not None and halving[0] is not None:
+            # round 16: both execution quotes side by side (the A/B
+            # ratio's provenance) — the exchange itself is charged once
+            # above, through ``delta_gather`` at the RESOLVED algo
+            terms["halving_exchange"] = int(halving[0])
+            terms["gather_exchange"] = int(halving[1])
         return terms
 
     def hbm_bytes_per_round(self) -> int:
@@ -2067,9 +2375,10 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
     # elementwise with the all_gather layout).
     F_g = seen_g = g_alive = g_byz = g_defer = None
     fr_sparse = fr_words = fr_sparse_ici = None
+    fr_halving = fr_halving_ici = None
     if fr is not None:
-        F_g, fr, fr_sparse, fr_words, fr_sparse_ici = \
-            _frontier_exchange(
+        (F_g, fr, fr_sparse, fr_words, fr_sparse_ici, fr_halving,
+         fr_halving_ici) = _frontier_exchange(
                 sim, frontier_w, fr, fr_axis, fr_pmax_axes, fr_shards,
                 ici_axis=fr_ici_axis, n_hosts=fr_hosts)
         seen_g = fr.replica_w
@@ -2318,9 +2627,16 @@ def aligned_round(sim: AlignedSimulator, state: AlignedState,
     # engine's.
     metrics["fr_sparse"] = fr_sparse
     metrics["fr_words"] = fr_words
+    # fr_halving: which EXECUTION the sparse regime used this round
+    # (1 = the recursive-halving butterfly, 0 = the table gather or a
+    # dense round) — differs between frontier_algo runs by design, so
+    # it sits OUTSIDE the parity surface, like fr_sparse sits outside
+    # the six canonical metrics
+    metrics["fr_halving"] = fr_halving
     if fr_sparse_ici is not None:
         # hierarchical meshes only: the ICI tier's regime this round
         # (fr_sparse is then the DCN tier's — same census and capacity
         # as the flat exchange, so that series stays bitwise flat)
         metrics["fr_sparse_ici"] = fr_sparse_ici
+        metrics["fr_halving_ici"] = fr_halving_ici
     return state, topo, metrics, fr

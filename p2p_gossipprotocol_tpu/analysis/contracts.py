@@ -27,8 +27,9 @@ FINGERPRINT_EXEMPT = {
     "mesh_devices": "layout",
     "msg_shards": "layout",
     # how-not-what knobs: bitwise-identical on or off by parity test
-    # (fuse_update PR 2, frontier_* PR 5, prefetch/overlap/sir_fuse
-    # PR 7, hier_* PR 8)
+    # (fuse_update PR 2, frontier_* PR 5 — the pattern also covers
+    # PR 16's frontier_algo, a third execution of the same sparse
+    # regime — prefetch/overlap/sir_fuse PR 7, hier_* PR 8)
     "fuse_update": "bitwise-knob",
     "frontier_*": "bitwise-knob",
     "prefetch_depth": "bitwise-knob",
@@ -122,9 +123,9 @@ CLAMP_CHOKEPOINTS = {
 #: the resolved statics a from_config-style resolver may weaken
 DEGRADE_KNOBS = {
     "block_perm", "pull_window", "fuse_update", "frontier_mode",
-    "prefetch_depth", "overlap_mode", "sir_fuse", "hier_mode",
-    "hier_hosts", "hier_devs", "mesh_devices", "msg_shards",
-    "n_msgs", "n_messages", "roll_groups",
+    "frontier_algo", "prefetch_depth", "overlap_mode", "sir_fuse",
+    "hier_mode", "hier_hosts", "hier_devs", "mesh_devices",
+    "msg_shards", "n_msgs", "n_messages", "roll_groups",
 }
 
 # ---------------------------------------------------------------------
@@ -192,8 +193,8 @@ TELEMETRY_BANNED_IMPORTS = ("jax",)
 #: registered heuristic_* fallbacks included)
 AUTO_STATICS = {
     "block_perm", "frontier_mode", "frontier_threshold",
-    "prefetch_depth", "overlap_mode", "hier_mode", "sir_fuse",
-    "serve_chunk",
+    "frontier_algo", "prefetch_depth", "overlap_mode", "hier_mode",
+    "sir_fuse", "serve_chunk",
 }
 
 # ---------------------------------------------------------------------

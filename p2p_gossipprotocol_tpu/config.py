@@ -124,6 +124,15 @@ _SIM_INT_KEYS = {
     # dense path by construction (docs/ARCHITECTURE.md "The frontier
     # seam").
     "frontier_mode": "frontier_mode",
+    # aligned engine, sharded meshes: HOW the sparse delta exchange
+    # executes — 1 = recursive-halving sparse allreduce (log2(M)
+    # pairwise ppermute merges of the compacted tables; each chip
+    # receives O(merged capacity x log M) bytes instead of the
+    # gather's O(M x K)), 0 = the round-8 table all-gather, -1
+    # (default) = auto (halving on the compiled path, gather under
+    # interpret).  Bitwise-identical either way, regime trajectory
+    # included (docs/PERFORMANCE.md "Round 16").
+    "frontier_algo": "frontier_algo",
     # aligned engine: double-buffered DMA pipelining of the gossip
     # kernels' sender stream — 2 = the manual copy stream (block k+1
     # prefetches while k computes), 0 = the legacy BlockSpec pipeline,
@@ -383,6 +392,14 @@ class NetworkConfig:
         # The capacity is bitwise-safe at any value (sparse == dense by
         # seen-set monotonicity), which is what makes it tunable.
         self.frontier_threshold = -1.0
+        # HOW the sparse regime moves its delta tables cross-chip —
+        # -1 = AUTO (the recursive-halving sparse allreduce on the
+        # compiled path, the table gather under interpret — the
+        # butterfly's sort/merge work inverts on CPU, the
+        # frontier_mode rule), 0 = gather, 1 = halving.  A third way
+        # to EXECUTE the same sparse regime: bitwise-identical state
+        # AND metrics, so forcing either is always SAFE.
+        self.frontier_algo = -1
         # Round-10 schedule knobs, all -1 = AUTO (engaged on the
         # compiled TPU path, off under interpret — the frontier_mode
         # rule; all three are bitwise-identical to the legacy schedule,
@@ -643,6 +660,10 @@ class NetworkConfig:
             raise ConfigError(
                 "frontier_threshold must be in (0, 1], or -1 "
                 "(auto-tuned)")
+        if self.frontier_algo not in (-1, 0, 1):
+            raise ConfigError(
+                "frontier_algo must be -1 (auto), 0 (gather), or 1 "
+                "(recursive-halving sparse allreduce)")
         if self.prefetch_depth not in (-1, 0, 2):
             raise ConfigError(
                 "prefetch_depth must be -1 (auto), 0 (pipelined), or 2 "

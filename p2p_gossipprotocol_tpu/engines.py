@@ -390,6 +390,7 @@ def _build_simulator(cfg, *, n_peers, mesh_devices, msg_shards, clamps):
             faults=sim.faults,
             frontier_mode=sim.frontier_mode,
             frontier_threshold=sim.frontier_threshold,
+            frontier_algo=sim.frontier_algo,
             prefetch_depth=sim.prefetch_depth,
             overlap_mode=sim.overlap_mode,
             hier_mode=sim.hier_mode,

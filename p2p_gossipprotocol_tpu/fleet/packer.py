@@ -55,8 +55,11 @@ def bucket_signature(sim) -> tuple:
         sim.fuse_update, sim.pull_window, sim._pull_slots,
         # the RESOLVED frontier block-skip flag, not the raw mode: it
         # alone decides whether the skip tables enter the trace (the
-        # delta exchange never runs on the fleet's single device)
-        sim._frontier_skip,
+        # delta exchange never runs on the fleet's single device); the
+        # resolved exchange algorithm rides next to it for the same
+        # one-program-per-bucket discipline (round 16 — like _overlap,
+        # it never engages on one device but keys the program family)
+        sim._frontier_skip, sim._frontier_algo,
         # resolved round-10 schedule statics: the prefetch stream
         # changes the compiled kernel (scratch ring + manual DMA); the
         # overlap split never engages on the fleet's single device but

@@ -116,6 +116,12 @@ class Aligned2DShardedSimulator:
     #: the same branch of the compiled conditional.
     frontier_mode: int = 0
     frontier_threshold: float = None  # type: ignore[assignment]
+    #: sparse-allreduce execution of the delta exchange (round 16):
+    #: same resolution and bitwise contract as the 1-D engine's
+    #: frontier_algo — each msg shard runs its own butterfly over the
+    #: peer axis (the fit census reduces over BOTH axes, so every
+    #: device takes the same branch of the nested conditional).
+    frontier_algo: int = 0
     #: round-10 schedule knobs (aligned.AlignedSimulator): the msg axis
     #: is exchange-free, so the overlap split applies to the peer-axis
     #: gather exactly as on the 1-D engine.
@@ -155,6 +161,7 @@ class Aligned2DShardedSimulator:
             fuse_update=self.fuse_update,
             pull_window=self.pull_window, faults=self.faults,
             frontier_mode=self.frontier_mode, **fr_kw,
+            frontier_algo=self.frontier_algo,
             prefetch_depth=self.prefetch_depth,
             overlap_mode=self.overlap_mode,
             hier_hosts=self.n_hosts, hier_devs=self.devs_per_host,
@@ -308,9 +315,11 @@ class Aligned2DShardedSimulator:
                                             "frontier_size", "live_peers",
                                             "evictions", "redeliveries")}
             if fr is not None:
-                metric_spec.update(fr_sparse=P(), fr_words=P())
+                metric_spec.update(fr_sparse=P(), fr_words=P(),
+                                   fr_halving=P())
                 if self._hier:
                     metric_spec["fr_sparse_ici"] = P()
+                    metric_spec["fr_halving_ici"] = P()
 
             if fr is None:
                 def scanned(st, tp):
@@ -350,8 +359,10 @@ class Aligned2DShardedSimulator:
         if fr is not None:
             res.fr_sparse = np.asarray(ys["fr_sparse"])
             res.fr_words = np.asarray(ys["fr_words"])
+            res.fr_halving = np.asarray(ys["fr_halving"])
             if self._hier:
                 res.fr_sparse_ici = np.asarray(ys["fr_sparse_ici"])
+                res.fr_halving_ici = np.asarray(ys["fr_halving_ici"])
         return res
 
     def run_to_coverage(self, target: float = 0.99, max_rounds: int = 256,

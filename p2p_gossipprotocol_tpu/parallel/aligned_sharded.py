@@ -104,6 +104,12 @@ class AlignedShardedSimulator:
     #: bitwise-identical to the dense path, regime switch included.
     frontier_mode: int = 0
     frontier_threshold: float = None  # type: ignore[assignment]
+    #: sparse-allreduce execution of the delta exchange (round 16,
+    #: aligned.AlignedSimulator.frontier_algo): 1 = recursive-halving
+    #: butterfly (log2(M) ppermute merges, O(merged capacity x log M)
+    #: received bytes per chip), 0 = the round-8 table gather, -1 auto.
+    #: Bitwise-identical either way — regime trajectory included.
+    frontier_algo: int = 0
     #: round-10 schedule knobs (aligned.AlignedSimulator): the manual
     #: double-buffered DMA stream, and the self/remote push-pass split
     #: that hides this engine's per-round exchange behind the
@@ -154,6 +160,7 @@ class AlignedShardedSimulator:
             pull_window=self.pull_window,
             faults=self.faults,
             frontier_mode=self.frontier_mode, **fr_kw,
+            frontier_algo=self.frontier_algo,
             prefetch_depth=self.prefetch_depth,
             overlap_mode=self.overlap_mode,
             hier_hosts=self.n_hosts, hier_devs=self.devs_per_host,
@@ -293,9 +300,10 @@ class AlignedShardedSimulator:
                                    "frontier_size", "live_peers",
                                    "evictions", "redeliveries")}
         if self._frontier:
-            metric.update(fr_sparse=P(), fr_words=P())
+            metric.update(fr_sparse=P(), fr_words=P(), fr_halving=P())
             if self._hier:
                 metric["fr_sparse_ici"] = P()
+                metric["fr_halving_ici"] = P()
         return st, tp, metric
 
     def run(self, rounds: int, state: AlignedState | None = None,
@@ -357,8 +365,10 @@ class AlignedShardedSimulator:
             # count) — not SimResult fields, attached for the A/B
             res.fr_sparse = np.asarray(ys["fr_sparse"])
             res.fr_words = np.asarray(ys["fr_words"])
+            res.fr_halving = np.asarray(ys["fr_halving"])
             if self._hier:
                 res.fr_sparse_ici = np.asarray(ys["fr_sparse_ici"])
+                res.fr_halving_ici = np.asarray(ys["fr_halving_ici"])
         return res
 
     def run_to_coverage(self, target: float = 0.99, max_rounds: int = 256,

@@ -55,8 +55,9 @@ from p2p_gossipprotocol_tpu.tuning import cache as tuning_cache
 #: module docstring; the parity tests behind each: test_frontier.py,
 #: test_prefetch.py, test_overlap.py, test_hier.py, test_sir_fuse.py,
 #: test_serve.py, test_tuning.py).
-TUNABLE = ("frontier_mode", "frontier_threshold", "prefetch_depth",
-           "overlap_mode", "hier_mode", "sir_fuse", "serve_chunk")
+TUNABLE = ("frontier_mode", "frontier_threshold", "frontier_algo",
+           "prefetch_depth", "overlap_mode", "hier_mode", "sir_fuse",
+           "serve_chunk")
 
 #: signature schema tag — bump when the tuple layout changes so old
 #: cache entries miss instead of misresolving.
@@ -78,8 +79,9 @@ FRONTIER_THRESHOLD_DEFAULT = 1.0 / 64.0
 
 def heuristic_on(requested: int, interpret: bool) -> bool:
     """The shared auto rule for the 0/1 schedule knobs (frontier_mode,
-    overlap_mode, hier_mode): -1 = on for the compiled path, off under
-    interpret (the round-6/8/10 inversion precedent); 0/1 force."""
+    frontier_algo, overlap_mode, hier_mode): -1 = on for the compiled
+    path, off under interpret (the round-6/8/10 inversion precedent);
+    0/1 force."""
     return requested == 1 or (requested == -1 and not interpret)
 
 

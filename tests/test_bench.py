@@ -94,9 +94,13 @@ def test_bench_reports_traffic_model():
     assert rec["achieved_gb_s"] is not None
     assert rec["liveness_every"] == 3
     assert rec["roll_groups"] == 4
-    # round-11 per-tier columns appear ONLY under GOSSIP_BENCH_HOSTS —
-    # headline rows stay comparable across rounds
+    # round-11 per-tier columns appear ONLY under GOSSIP_BENCH_HOSTS,
+    # round-16 exchange columns ONLY under GOSSIP_BENCH_EXCHANGE_SHARDS
+    # — headline rows stay comparable across rounds
     assert "dcn_gb" not in rec and "ici_gb" not in rec
+    assert "exchange_algo" not in rec
+    # ... but every row self-describes its resolved exchange execution
+    assert rec["resolved_statics"]["frontier_algo"] == 0   # interpret
 
 
 def test_bench_steady_state_and_loop_knobs():
@@ -133,6 +137,39 @@ def test_bench_fallback_omits_steady_and_carries_tpu_pointer():
     # came from, so a stale committed headline can't pass as fresh
     assert tpu["source"] in ("working-tree", "HEAD")
     assert tpu.get("recorded_at")
+
+
+def test_bench_exchange_columns():
+    """Round-16 exchange columns: GOSSIP_BENCH_EXCHANGE_SHARDS > 1
+    adds the per-chip received bytes of one sparse exchange round
+    under each execution — closed-form, reproducible from the row
+    alone (capacity and step count ride it): gather moves S tables of
+    2K+1 int32, halving 1 + log2(S).  The resolved exchange_algo
+    self-describes the row (gather under interpret on auto; forced
+    halving when the knob says so)."""
+    proc, rec = _run({"GOSSIP_BENCH_PLATFORM": "cpu",
+                      "JAX_PLATFORMS": "cpu",
+                      "GOSSIP_BENCH_EXCHANGE_SHARDS": "8",
+                      "GOSSIP_BENCH_FRONTIER_ALGO": "1"})
+    assert proc.returncode == 0, proc.stderr
+    assert rec["exchange_shards"] == 8
+    assert rec["exchange_algo"] == "halving"      # forced on
+    assert rec["resolved_statics"]["frontier_algo"] == 1
+    K = rec["exchange_capacity_words"]
+    steps = rec["exchange_halving_steps"]
+    assert steps == 3                             # log2(8)
+    assert rec["gather_bytes_round"] == 8 * (2 * K + 1) * 4
+    assert rec["halving_bytes_round"] == (1 + steps) * (2 * K + 1) * 4
+    # the acceptance ratio at 8 shards: exactly 2x fewer bytes
+    assert rec["gather_bytes_round"] == 2 * rec["halving_bytes_round"]
+    # auto keys off interpret: a CPU row with the knob unset resolves
+    # gather and says so
+    proc2, rec2 = _run({"GOSSIP_BENCH_PLATFORM": "cpu",
+                        "JAX_PLATFORMS": "cpu",
+                        "GOSSIP_BENCH_EXCHANGE_SHARDS": "8"})
+    assert proc2.returncode == 0, proc2.stderr
+    assert rec2["exchange_algo"] == "gather"
+    assert rec2["resolved_statics"]["frontier_algo"] == 0
 
 
 def test_bench_hier_tier_columns():

@@ -177,6 +177,119 @@ def test_frontier_terms_match_closed_form():
     assert w_sparse["delta_gather"] * 2 <= w_dense["delta_gather"]
 
 
+def test_halving_exchange_matches_closed_form():
+    """Round-16 sparse-allreduce terms, pinned on both paths: with
+    frontier_algo off the model is bit-for-bit the round-8 accounting
+    (no halving keys at all); with it on, ``delta_gather`` charges the
+    execution the runtime takes — ``(1 + log2(M))`` capacity tables
+    when the merged total fits (the +1 self-table base anchors the
+    M=1 degenerate to the gather pricing), the gather fallback when
+    only per-shard tables fit, the dense planes above capacity — and
+    ``halving_exchange``/``gather_exchange`` report both quotes
+    side by side, excluded from ``total`` like the tier split."""
+    from p2p_gossipprotocol_tpu.aligned import (frontier_capacity,
+                                                halving_steps,
+                                                project_exchange)
+
+    S = 8
+    gat = _sim(roll_groups=4, rowblk=64, frontier_mode=1)
+    hal = _sim(roll_groups=4, rowblk=64, frontier_mode=1,
+               frontier_algo=1)
+    W, R, C = hal.n_words, hal.topo.rows, 128
+    wp, plane = W * R * C * 4, R * C * 4
+    L = W * (R // S) * C
+    K = frontier_capacity(hal.frontier_threshold, L)
+    fit = K / (S * L)                 # merged total == K: fits exactly
+    t_g = gat.traffic_model(frontier_fill=fit, n_shards=S)
+    t_h = hal.traffic_model(frontier_fill=fit, n_shards=S)
+    # off-path parity: no halving keys, same terms
+    assert "halving_exchange" not in t_g and "gather_exchange" not in t_g
+    # fitted halving round: (1 + log2(S)) tables vs the gather's S
+    steps = halving_steps(S)
+    assert t_h["delta_gather"] == (1 + steps) * (2 * K + 1) * 4 + plane
+    assert t_g["delta_gather"] == S * (2 * K + 1) * 4 + plane
+    assert t_h["halving_exchange"] == t_h["delta_gather"]
+    assert t_h["gather_exchange"] == t_g["delta_gather"]
+    # the acceptance ratio on the table bytes themselves: exactly
+    # S / (1 + log2(S)) = 2.0 at 8 shards
+    assert (t_h["gather_exchange"] - plane) \
+        == 2 * (t_h["halving_exchange"] - plane)
+    # per-shard-fits-but-merged-overflows: priced at the gather
+    # fallback the runtime executes
+    over = hal.traffic_model(frontier_fill=K / (2 * L), n_shards=S)
+    assert over["delta_gather"] == S * (2 * K + 1) * 4 + plane
+    assert over["halving_exchange"] == over["gather_exchange"]
+    # above capacity: both executions are the dense planes
+    dense = hal.traffic_model(frontier_fill=1.0, n_shards=S)
+    assert dense["delta_gather"] == wp + plane
+    # the reporting keys never enter total (the tier-split discipline)
+    assert t_h["total"] == sum(
+        v for k, v in t_h.items()
+        if k not in ("total", "ici_gather", "dcn_gather",
+                     "halving_exchange", "gather_exchange"))
+    # flat-degenerate: one shard's halving quote == the gather quote
+    e1h = project_exchange(n_peers=R * C, n_msgs=hal.n_msgs, n_shards=1,
+                           frontier_fill=fit, rows=R, algo=1)
+    e1g = project_exchange(n_peers=R * C, n_msgs=hal.n_msgs, n_shards=1,
+                           frontier_fill=fit, rows=R, algo=0)
+    assert e1h["delta_gather"] == e1g["delta_gather"]
+    assert e1h["halving_exchange"] == e1h["gather_exchange"]
+    # non-power-of-two member count: structural gather pricing
+    e6 = project_exchange(n_peers=R * C, n_msgs=hal.n_msgs, n_shards=6,
+                          frontier_fill=0.0001, rows=R, algo=1)
+    assert e6["halving_exchange"] == e6["gather_exchange"]
+
+
+def test_halving_exchange_hier_tiers():
+    """Per-tier halving quotes under the 2x4 factorization: the DCN
+    tier at H=2 degenerates (one pairwise exchange == one gathered
+    table), the ICI tier at D=4 drops from 3 to 2 column tables; both
+    fall back per tier when their merged totals overflow."""
+    from p2p_gossipprotocol_tpu.aligned import (frontier_capacity,
+                                                project_exchange)
+
+    S, H = 8, 2
+    D = S // H
+    hal = _sim(roll_groups=4, rowblk=64, frontier_mode=1,
+               frontier_algo=1)
+    W, R, C = hal.n_words, hal.topo.rows, 128
+    L = W * (R // S) * C
+    K = frontier_capacity(hal.frontier_threshold, L)
+    Kc = frontier_capacity(hal.frontier_threshold, L * H)
+    sl = (R // S) * C * 4
+    fit = K / (S * L)
+    eh = project_exchange(n_peers=R * C, n_msgs=hal.n_msgs, n_shards=S,
+                          n_hosts=H, frontier_fill=fit, rows=R, algo=1)
+    eg = project_exchange(n_peers=R * C, n_msgs=hal.n_msgs, n_shards=S,
+                          n_hosts=H, frontier_fill=fit, rows=R, algo=0)
+    # DCN: log2(2) = 1 table each way (the H=2 degenerate)
+    assert eh["dcn_gather"] == eg["dcn_gather"] \
+        == (H - 1) * ((2 * K + 1) * 4 + sl)
+    # ICI: log2(4) = 2 column tables vs the gather's D-1 = 3
+    assert eh["ici_gather"] == 2 * (2 * Kc + 1) * 4 + (D - 1) * H * sl
+    assert eg["ici_gather"] == 3 * (2 * Kc + 1) * 4 + (D - 1) * H * sl
+    assert eh["delta_gather"] == eh["dcn_gather"] + eh["ici_gather"]
+    assert eh["halving_exchange"] == eh["delta_gather"]
+    assert eh["gather_exchange"] == eg["delta_gather"]
+    # a sim whose RESOLVED statics are hier+halving prices this via
+    # traffic_model directly
+    h_sim = _sim(roll_groups=4, rowblk=64, frontier_mode=1,
+                 frontier_algo=1, hier_hosts=H, hier_devs=D,
+                 hier_mode=1)
+    th = h_sim.traffic_model(frontier_fill=fit, n_shards=S)
+    assert th["dcn_gather"] == eh["dcn_gather"]
+    assert th["ici_gather"] == eh["ici_gather"]
+    # the 1B x 256 budget (ROADMAP item 4) under O(merged): the
+    # halving DCN quote sits well under the gather one at 64 hosts
+    b_h = project_exchange(n_peers=1 << 30, n_msgs=256, n_shards=256,
+                           n_hosts=64, frontier_fill=0.0001, fused=True,
+                           algo=1)
+    b_g = project_exchange(n_peers=1 << 30, n_msgs=256, n_shards=256,
+                           n_hosts=64, frontier_fill=0.0001, fused=True,
+                           algo=0)
+    assert b_g["dcn_gather"] >= 2 * b_h["dcn_gather"]
+
+
 def test_hier_tier_terms_match_closed_form():
     """Round-11 per-tier terms, pinned closed-form on both paths.
 
