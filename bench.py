@@ -75,7 +75,14 @@ at GOSSIP_BENCH_SERVE_PEERS (16k) x GOSSIP_BENCH_SERVE_SLOTS (8) and
 report serve_p50_ms / serve_p99_ms (admission-to-result latency) and
 serve_qps — reproducible from the row alone as serve_n /
 serve_wall_s; the offered-load sweep with Poisson arrivals lives in
-benchmarks/measure_round12.py.
+benchmarks/measure_round12.py.  GOSSIP_BENCH_SERVE_INFLIGHT (0 =
+in-process facade): > 0 drives the same requests OVER THE WIRE
+through one round-17 pipelined ServeClient with that in-flight
+window; GOSSIP_BENCH_SERVE_AUTOSCALE (0/1) arms the slot-width
+control loop.  Both land on the row as serve_inflight /
+autoscale_events / slot_width_{min,max} (max = the run's high-water
+width); the pipelining x autoscaling saturation A/B lives in
+benchmarks/measure_round17.py.
 GOSSIP_BENCH_TELEMETRY (0 = off): also A/B the chunked runner with
 the flight-recorder telemetry plane off vs on
 (GOSSIP_BENCH_TELEMETRY_ROUNDS, 16) and report obs_overhead_pct —
@@ -687,16 +694,25 @@ def _bench_obs_overhead(sim, rounds: int | None = None,
 
 def _bench_serve(n_req: int, n_peers: int, slots: int) -> dict:
     """The serving columns: submit ``n_req`` independent-seed scenarios
-    to an in-process resident server (max offered load — everything
-    enqueued up front), wait for every row, report the p50/p99
-    admission-to-result latency and the sustained qps.  The Poisson
-    offered-load sweep (and the 5x-vs-sequential acceptance A/B) lives
-    in benchmarks/measure_round12.py."""
+    to a resident server (max offered load — everything enqueued up
+    front), wait for every row, report the p50/p99 admission-to-result
+    latency and the sustained qps.  GOSSIP_BENCH_SERVE_INFLIGHT > 0
+    drives the requests OVER THE WIRE through one pipelined
+    ServeClient (window = the knob; the round-17 async submit/await
+    surface) instead of the in-process facade, and
+    GOSSIP_BENCH_SERVE_AUTOSCALE=1 lets the slot-width control loop
+    resize under the burst — both recorded on the row
+    (serve_inflight / autoscale_events / slot_width_{min,max}), so
+    every row is a self-describing A/B artifact.  The Poisson
+    offered-load sweep (and the saturation-knee acceptance A/B) lives
+    in benchmarks/measure_round12.py / measure_round17.py."""
     import tempfile
 
     from p2p_gossipprotocol_tpu.config import NetworkConfig
     from p2p_gossipprotocol_tpu.serve import GossipService
 
+    inflight = _env_int("GOSSIP_BENCH_SERVE_INFLIGHT", 0)
+    autoscale = _env_int("GOSSIP_BENCH_SERVE_AUTOSCALE", 0)
     cfg_text = (f"127.0.0.1:8000\nbackend=jax\nn_peers={n_peers}\n"
                 f"n_messages=16\navg_degree=8\nrounds=64\n")
     with tempfile.NamedTemporaryFile("w", suffix=".txt",
@@ -708,13 +724,39 @@ def _bench_serve(n_req: int, n_peers: int, slots: int) -> dict:
     finally:
         os.unlink(path)
     svc = GossipService(cfg, slots=slots, queue_max=max(n_req, 1),
-                        target=TARGET_COV, rounds=MAX_ROUNDS).start()
-    t0 = time.perf_counter()
-    rids = [svc.submit({"prng_seed": s}) for s in range(n_req)]
-    for rid in rids:
-        svc.result(rid, timeout=1800)
-    wall = time.perf_counter() - t0
-    stats = svc.drain()
+                        target=TARGET_COV, rounds=MAX_ROUNDS,
+                        autoscale=bool(autoscale))
+    if inflight > 0:
+        from p2p_gossipprotocol_tpu.serve.server import (ServeClient,
+                                                         ServeServer)
+
+        server = ServeServer(svc, "127.0.0.1", 0).start()
+        client = ServeClient("127.0.0.1", server.port,
+                             window=inflight)
+        t0 = time.perf_counter()
+        rids = [p.wait() for p in
+                [client.submit_async({"prng_seed": s})
+                 for s in range(n_req)]]
+        waits = [client.result_async(r, timeout=1800) for r in rids]
+        for w in waits:
+            w.wait()
+        wall = time.perf_counter() - t0
+        # snapshot BEFORE drain: an autoscaled service shrinks/closes
+        # its now-idle buckets during the drain window, which would
+        # zero the width columns the row exists to record
+        stats = svc.stats()
+        client.drain(wait_s=1800)
+        client.close()
+        server.stop()
+    else:
+        svc.start()
+        t0 = time.perf_counter()
+        rids = [svc.submit({"prng_seed": s}) for s in range(n_req)]
+        for rid in rids:
+            svc.result(rid, timeout=1800)
+        wall = time.perf_counter() - t0
+        stats = svc.stats()
+        svc.drain()
     return {
         "serve_n": n_req, "serve_peers": n_peers,
         "serve_slots": slots,
@@ -722,6 +764,17 @@ def _bench_serve(n_req: int, n_peers: int, slots: int) -> dict:
         # seam resolved it (round 14 — cfg default -1 = auto-tuned)
         "serve_chunk": svc.chunk,
         "serve_chunk_from": svc.chunk_source,
+        # round 17: the wire window driven (0 = in-process facade) and
+        # what the autoscaler did — artifact-only reproducible, like
+        # every serving column
+        "serve_inflight": inflight,
+        "autoscale_events": stats.get("autoscale_events", 0),
+        "slot_width_min": stats.get("slot_width_min", slots),
+        # max is the run's HIGH-WATER width (slot_width_peak): the
+        # autoscaler may have shrunk back before the row lands
+        "slot_width_max": stats.get("slot_width_peak",
+                                    stats.get("slot_width_max",
+                                              slots)),
         "serve_wall_s": round(wall, 4),
         "serve_p50_ms": stats["p50_ms"],
         "serve_p99_ms": stats["p99_ms"],

@@ -39,7 +39,12 @@ FINGERPRINT_EXEMPT = {
     # planes that watch or place a run, never steer it (supervise_*
     # PR 6, telemetry_* PR 10, serve_*/sweep_* PR 4/9 — the serving
     # and sweep surfaces wrap scenarios whose own keys ARE
-    # fingerprinted per scenario)
+    # fingerprinted per scenario; the round-17 wire/autoscale keys —
+    # serve_pipeline/serve_inflight/serve_autoscale* — ride the
+    # serve_* pattern DELIBERATELY: they shape how the plane moves
+    # requests and resizes buckets, never a scenario's trajectory,
+    # and they carry no -1-auto spelling, so they belong here and
+    # not in AUTO_STATICS)
     "supervise": "plane",
     "supervise_*": "plane",
     "telemetry": "plane",

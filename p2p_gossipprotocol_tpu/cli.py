@@ -452,11 +452,18 @@ def _run_serve(cfg: NetworkConfig, args) -> int:
     if not args.quiet:
         rebound = (f" (rebound from {server.rebound_from})"
                    if server.rebound_from else "")
+        autoscale = (f"autoscale "
+                     f"[{service.autoscaler.min_slots},"
+                     f"{service.autoscaler.max_slots}]"
+                     if service.autoscale else
+                     f"{service.slots} slots/bucket")
         print(f"[jax/serve] resident server on {cfg.get_local_ip()}:"
-              f"{server.port}{rebound} — {service.slots} "
-              f"slots/bucket, <= {service.max_buckets} buckets, "
+              f"{server.port}{rebound} — {autoscale}, "
+              f"<= {service.max_buckets} buckets, "
               f"queue <= {service.scheduler.queue_max}, target "
-              f"{service.target:g}, chunk {service.chunk}")
+              f"{service.target:g}, chunk {service.chunk}, "
+              f"pipelined wire (window "
+              f"{cfg.serve_inflight if cfg.serve_pipeline else 0})")
     server.wait()
     server.stop()
     if stop["salvage"]:

@@ -223,6 +223,24 @@ _SIM_INT_KEYS = {
     # earliest-deadline-first queue but executes everything).
     "serve_replicas": "serve_replicas",
     "serve_deadline_shed": "serve_deadline_shed",
+    # Wire pipelining (round 17; serve/server.py): serve_pipeline=1
+    # lets clients (the fleet router's inner hop, bench, load drivers)
+    # multiplex many in-flight RPCs over one connection, matched by
+    # seq correlation ids; serve_inflight bounds the per-connection
+    # window.  The server always demultiplexes; these keys shape the
+    # CLIENT half, so old single-RPC callers keep working either way.
+    "serve_pipeline": "serve_pipeline",
+    "serve_inflight": "serve_inflight",
+    # Telemetry-driven autoscaling (round 17; serve/autoscale.py):
+    # serve_autoscale=1 lets the serving loop consume the occupancy /
+    # queue-depth gauges and resize bucket slot widths (power-of-two
+    # grow/shrink between serve_autoscale_min and serve_autoscale_max,
+    # live occupants migrated bitwise) and close idle buckets, with
+    # serve_autoscale_hold ticks of hysteresis so it never flaps.
+    "serve_autoscale": "serve_autoscale",
+    "serve_autoscale_min": "serve_autoscale_min",
+    "serve_autoscale_max": "serve_autoscale_max",
+    "serve_autoscale_hold": "serve_autoscale_hold",
     # Self-healing multi-process runs (runtime/supervisor.py; jax
     # backend, engine=aligned): supervise=1 launches the run as
     # supervise_workers worker processes under the health plane —
@@ -465,6 +483,18 @@ class NetworkConfig:
         self.serve_deadline_ms = 0.0     # default request deadline; 0=off
         self.serve_deadline_shed = 1     # shed expired requests (typed)
         self.serve_health_s = 1.0        # heartbeat-staleness deadline
+        # Wire pipelining (round 17): client-side multiplexing over one
+        # connection (the server always demultiplexes seq-carrying
+        # documents; old single-RPC clients are unaffected)
+        self.serve_pipeline = 1          # 1 = clients pipeline the wire
+        self.serve_inflight = 32         # bounded in-flight RPC window
+        # Telemetry-driven autoscaling (round 17): the serving loop
+        # consumes the occupancy/queue-depth gauges and resizes bucket
+        # slot widths / closes idle buckets, with hysteresis
+        self.serve_autoscale = 0         # 1 = autoscale the fleet shape
+        self.serve_autoscale_min = 1     # narrowest slot width
+        self.serve_autoscale_max = 64    # widest slot width
+        self.serve_autoscale_hold = 3    # shrink/close hysteresis ticks
         # Telemetry plane (telemetry/; docs/OBSERVABILITY.md)
         self.telemetry = 0               # 1 = spans+counters+roofline on
         self.telemetry_ring = 4096       # flight-recorder ring bound
@@ -604,9 +634,23 @@ class NetworkConfig:
             if getattr(self, k) < 0:
                 raise ConfigError(f"{k} must be non-negative")
         for k in ("serve_slots", "serve_queue_max", "serve_max_buckets",
+                  "serve_inflight", "serve_autoscale_min",
+                  "serve_autoscale_max", "serve_autoscale_hold",
                   "telemetry_ring"):
             if getattr(self, k) < 1:
                 raise ConfigError(f"{k} must be >= 1")
+        if self.serve_pipeline not in (0, 1):
+            raise ConfigError(
+                "serve_pipeline must be 0 (single-RPC clients) or 1 "
+                "(clients multiplex a bounded serve_inflight window)")
+        if self.serve_autoscale not in (0, 1):
+            raise ConfigError(
+                "serve_autoscale must be 0 (fixed serving shape) or 1 "
+                "(telemetry-driven slot-width/bucket autoscaling)")
+        if self.serve_autoscale_max < self.serve_autoscale_min:
+            raise ConfigError(
+                "serve_autoscale_max must be >= serve_autoscale_min "
+                "(the slot-width band the autoscaler moves within)")
         if self.serve_chunk != -1 and self.serve_chunk < 1:
             raise ConfigError(
                 "serve_chunk must be >= 1, or -1 (auto-tuned)")

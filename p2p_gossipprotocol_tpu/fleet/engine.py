@@ -270,6 +270,24 @@ class FleetBucket:
                                 jnp.int32(slot), state, leaves, ytab,
                                 seed, srcs_row)
 
+    def extract_slot_payload(self, bstate, btopo, seeds, srcs,
+                             slot: int):
+        """The inverse of :meth:`admit_args`, read from the LIVE batch:
+        slot ``slot``'s current state, overlay leaves, liveness seed
+        and source row, in exactly the payload shape
+        :meth:`admit_into` scatters.  This is the migration primitive
+        the serving plane's autoscaler uses to move an in-flight
+        occupant between bucket widths (round 17): the occupant's
+        world — PRNG chain, rewired lanes, fault-gate inputs included —
+        is carried bit-for-bit, so the resumed trajectory in the new
+        batch is the same one the old batch would have computed (the
+        vmapped round is per-slot independent, the PR 4 contract)."""
+        state = jax.tree.map(lambda x: x[slot], bstate)
+        leaves = {k: getattr(btopo, k)[slot]
+                  for k in ALIGNED_TOPO_LEAVES}
+        ytab = None if btopo.ytab is None else btopo.ytab[slot]
+        return state, leaves, ytab, seeds[slot], srcs[slot]
+
     def mark_done(self, done, slot: int):
         """Retire ``slot``: the done mask freezes it on-device (inert —
         the convergence-masking machinery, reused as the slot-free

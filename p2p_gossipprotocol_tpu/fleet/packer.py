@@ -34,6 +34,14 @@ resolved statics below (``_prefetch``/``_overlap``/``_frontier_skip``)
 are read POST-resolution, a cache-substituted build routes into its
 own bucket automatically — tuned and untuned scenarios never share a
 compiled program unless their schedules really are identical.
+
+The serving plane's SLOT COUNT is deliberately absent: a bucket's
+width is the leading batch axis the engine vmaps over, not a static of
+the per-scenario round program, which is what lets the round-17
+autoscaler grow/shrink a resident bucket (migrating occupants through
+the admit scatter) without ever changing where a request routes — the
+signature, and therefore the affinity key the fleet router sticks to,
+is width-invariant.
 """
 
 from __future__ import annotations

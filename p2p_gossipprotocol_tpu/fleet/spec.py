@@ -60,7 +60,9 @@ _RESERVED = {"engine", "mesh_devices", "msg_shards", "sweep_file",
              "serve_max_buckets", "serve_chunk", "serve_rounds",
              "serve_target", "serve_results", "serve_replicas",
              "serve_deadline_ms", "serve_deadline_shed",
-             "serve_health_s",
+             "serve_health_s", "serve_pipeline", "serve_inflight",
+             "serve_autoscale", "serve_autoscale_min",
+             "serve_autoscale_max", "serve_autoscale_hold",
              # telemetry watches the PROCESS, never one scenario
              "telemetry", "telemetry_ring", "telemetry_dump_dir"}
 

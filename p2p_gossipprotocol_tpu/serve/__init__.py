@@ -24,12 +24,23 @@ serving scale, borrowing LLM-serving continuous batching:
 * every served scenario stays **bitwise-identical to its solo run**
   regardless of what was admitted or retired around it
   (tests/test_serve.py), and per-scenario latency is accounted
-  enqueue→admit→converge→result with p50/p99 in ``stats()``.
+  enqueue→admit→converge→result with p50/p99 in ``stats()``;
+* round 17 made the plane scale with offered load instead of with its
+  static configuration: the wire carries many in-flight RPCs per
+  connection (``seq`` correlation ids, :class:`serve.server
+  .ServeClient` ``window`` + async submit/await, old single-RPC
+  clients unaffected) and a telemetry-driven control loop
+  (:mod:`serve.autoscale`) consumes the occupancy/queue-depth gauges
+  to grow/shrink bucket slot widths (live occupants migrated bitwise)
+  and open/close buckets, every decision a typed ``autoscale`` event.
 
 docs/ARCHITECTURE.md "The serving seam" has the admission rules and
 why the bitwise contract holds.
 """
 
+from p2p_gossipprotocol_tpu.serve.autoscale import (Autoscaler,
+                                                    AutoscaleDecision,
+                                                    BucketObservation)
 from p2p_gossipprotocol_tpu.serve.scheduler import (SHED_AT_ADMISSION,
                                                     SHED_IN_QUEUE,
                                                     SHED_ON_DRAIN, Request,
@@ -37,6 +48,7 @@ from p2p_gossipprotocol_tpu.serve.scheduler import (SHED_AT_ADMISSION,
                                                     ServeShed)
 from p2p_gossipprotocol_tpu.serve.service import GossipService, ServeBucket
 
-__all__ = ["GossipService", "Request", "Scheduler", "ServeBucket",
+__all__ = ["Autoscaler", "AutoscaleDecision", "BucketObservation",
+           "GossipService", "Request", "Scheduler", "ServeBucket",
            "ServeReject", "ServeShed", "SHED_AT_ADMISSION",
            "SHED_IN_QUEUE", "SHED_ON_DRAIN"]

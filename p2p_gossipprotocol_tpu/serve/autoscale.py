@@ -1,0 +1,176 @@
+"""Telemetry-driven autoscaling policy for the serving plane.
+
+The round-12 Poisson sweep showed the consequence of a static serving
+shape: a fixed-slot-width bucket pays its FULL width per chunk whatever
+its occupancy (the padded-dense-cost-for-sparse-occupancy problem —
+the shape-bucketed-packing workaround of arXiv:1906.11786, applied to
+slots instead of graphs), and past the ~4 QPS knee the queue grows
+without the shape answering.  PR 10's telemetry has published the
+signals needed to close that loop — the ``serve_queue_depth`` /
+``serve_slots_free`` gauges — with nothing consuming them.  This
+module is the consumer.
+
+:class:`Autoscaler` is PURE POLICY — stdlib only, no jax, no threads,
+no clocks: the serving loop feeds it one observation per tick (the
+exact per-bucket occupancy and queue-depth values it publishes as
+gauges in the same breath, so decisions are reproducible from the
+telemetry stream) and applies the returned decisions through the
+slot-swap machinery (``ServeBucket.resize`` — admit/mark_done
+scatters, every migrated scenario still bitwise its solo run).  Three
+actions, each a typed ``autoscale`` ledger event when applied:
+
+* **grow** — bucket effectively full AND same-signature requests are
+  waiting: double the slot width (power-of-two steps, capped at
+  ``serve_autoscale_max``).  Growth is the latency-critical direction,
+  so it fires on a single observation;
+* **shrink** — occupancy at or below a quarter of the width with no
+  queue pressure, sustained for ``serve_autoscale_hold`` consecutive
+  ticks: halve the width (floored at ``serve_autoscale_min`` and at
+  the live-occupant count);
+* **close** — a bucket idle with no waiting work for the hold period:
+  release it (the serving loop re-opens buckets on signature miss, so
+  closing is always safe).
+
+**Why it never flaps** (tests/test_autoscale.py pins this): the grow
+and shrink thresholds enclose a dead band — after a grow, occupancy
+lands near half of the new width, far above the quarter-width shrink
+line; after a shrink it lands near half, far below the
+full-and-queued grow line — and shrink/close additionally require the
+``hold``-tick streak while every applied action starts a cooldown of
+the same length.  A steady offered load therefore settles at one width
+and stays there; only a sustained change in load crosses the band.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+@dataclass(frozen=True)
+class AutoscaleDecision:
+    """One applied-or-proposed action. ``bucket`` is the ServeBucket's
+    stable uid (survives list reordering), ``to_slots`` the target
+    width (0 for close)."""
+
+    action: str                   # "grow" | "shrink" | "close"
+    bucket: int
+    from_slots: int
+    to_slots: int
+    occupancy: int                # live occupants at decision time
+    queue_depth: int              # same-signature requests waiting
+
+
+@dataclass(frozen=True)
+class BucketObservation:
+    """One bucket's signals for one tick — the values the serving loop
+    publishes as the occupancy/queue-depth gauges, handed to the
+    policy directly so the loop works identically with telemetry
+    disabled (the gauges are the observable twin, not the transport)."""
+
+    uid: int
+    slots: int
+    live: int                     # occupied slots
+    queue_depth: int              # queued requests with this signature
+
+
+class Autoscaler:
+    """Hysteresis-banded width controller (see module docstring)."""
+
+    #: grow when live >= GROW_FRAC * slots AND the queue is non-empty
+    GROW_FRAC = 0.75
+    #: shrink when live <= SHRINK_FRAC * slots AND the queue is empty
+    SHRINK_FRAC = 0.25
+
+    def __init__(self, *, min_slots: int = 1, max_slots: int = 64,
+                 hold: int = 3):
+        if min_slots < 1:
+            raise ValueError("serve_autoscale_min must be >= 1")
+        if max_slots < min_slots:
+            raise ValueError(
+                "serve_autoscale_max must be >= serve_autoscale_min")
+        if hold < 1:
+            raise ValueError("serve_autoscale_hold must be >= 1")
+        self.min_slots = int(min_slots)
+        self.max_slots = int(max_slots)
+        self.hold = int(hold)
+        self._shrink_streak: dict[int, int] = {}
+        self._close_streak: dict[int, int] = {}
+        self._cooldown: dict[int, int] = {}
+
+    # ------------------------------------------------------------------
+    def forget(self, uid: int) -> None:
+        """Drop a closed bucket's streak/cooldown state."""
+        self._shrink_streak.pop(uid, None)
+        self._close_streak.pop(uid, None)
+        self._cooldown.pop(uid, None)
+
+    def observe(self, buckets: list[BucketObservation]
+                ) -> list[AutoscaleDecision]:
+        """One control tick: per-bucket decisions for this observation
+        (at most one per bucket).  The caller applies them — and must
+        call :meth:`forget` for buckets it closes."""
+        out: list[AutoscaleDecision] = []
+        seen = set()
+        for b in buckets:
+            seen.add(b.uid)
+            d = self._judge(b)
+            if d is not None:
+                out.append(d)
+        # buckets that vanished without close (evicted at the cap)
+        for uid in list(self._cooldown) + list(self._shrink_streak) \
+                + list(self._close_streak):
+            if uid not in seen:
+                self.forget(uid)
+        return out
+
+    # ------------------------------------------------------------------
+    def _judge(self, b: BucketObservation) -> AutoscaleDecision | None:
+        cd = self._cooldown.get(b.uid, 0)
+        if cd > 0:
+            # cooldown ticks down; streaks keep counting so a
+            # sustained condition acts right when the cooldown ends
+            self._cooldown[b.uid] = cd - 1
+        # -- grow: full-and-queued, immediate (latency-critical) -------
+        if (b.queue_depth > 0 and b.slots < self.max_slots
+                and b.live >= self.GROW_FRAC * b.slots):
+            self._shrink_streak[b.uid] = 0
+            self._close_streak[b.uid] = 0
+            if cd > 0:
+                return None
+            to = min(_next_pow2(b.slots + 1), self.max_slots)
+            self._cooldown[b.uid] = self.hold
+            return AutoscaleDecision("grow", b.uid, b.slots, to,
+                                     b.live, b.queue_depth)
+        # -- close: empty and nothing waiting, sustained ---------------
+        if b.live == 0 and b.queue_depth == 0:
+            streak = self._close_streak.get(b.uid, 0) + 1
+            self._close_streak[b.uid] = streak
+            self._shrink_streak[b.uid] = 0
+            if streak >= self.hold and cd == 0:
+                self._cooldown[b.uid] = self.hold
+                return AutoscaleDecision("close", b.uid, b.slots, 0,
+                                         0, 0)
+            return None
+        self._close_streak[b.uid] = 0
+        # -- shrink: quarter-occupied, no pressure, sustained ----------
+        to = max(self.min_slots, b.slots // 2)
+        if (b.queue_depth == 0 and b.slots > self.min_slots
+                and b.live <= self.SHRINK_FRAC * b.slots
+                and b.live <= to):
+            streak = self._shrink_streak.get(b.uid, 0) + 1
+            self._shrink_streak[b.uid] = streak
+            if streak >= self.hold and cd == 0:
+                self._shrink_streak[b.uid] = 0
+                self._cooldown[b.uid] = self.hold
+                return AutoscaleDecision("shrink", b.uid, b.slots, to,
+                                         b.live, 0)
+            return None
+        self._shrink_streak[b.uid] = 0
+        return None

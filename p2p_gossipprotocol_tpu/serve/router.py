@@ -23,6 +23,15 @@ the traffic-bearing tier:
   non-per-scenario overrides, so the router pays one simulator build
   per scenario *family*, not per request.
 
+* **One pipelined connection per replica (round 17).**  The inner hop
+  used to open a fresh connection per forwarded result wait — pure
+  overhead at high offered load.  Each replica handle now holds ONE
+  ``serve_inflight``-windowed pipelined :class:`~p2p_gossipprotocol_tpu
+  .serve.server.ServeClient`: submits and result polls from every
+  waiter multiplex over it, matched by seq, completing out-of-order,
+  so fleet deployments no longer serialize on the inner connection
+  (``serve_pipeline=0`` restores the PR 13 shape for old replicas).
+
 * **Replica supervision.**  Replicas are ordinary ``--serve`` CLI
   children (``runtime/supervisor.py``'s serve-replica kind: own
   process group, own checkpoint dir, own port) that stamp the
@@ -95,20 +104,40 @@ class ReplicaHandle:
     recovering: bool = False             # one recovery per corpse
     generation: int = 0
     t_spawn: float = 0.0
-    #: serializes control-plane RPCs (submit/stats/drain) on the one
-    #: shared socket; result-waiting uses per-request connections
+    #: serializes RPCs on the one shared socket when the client is the
+    #: legacy single-RPC kind (serve_pipeline=0); a pipelined client
+    #: multiplexes — its seq matching makes concurrent callers safe,
+    #: so the lock is bypassed and result waits share this connection
+    #: too (round 17: one pipelined connection per replica, no
+    #: per-forwarded-RPC reconnects)
     rpc_lock: threading.Lock = field(default_factory=threading.Lock,
                                      repr=False)
 
+    @property
+    def pipelined(self) -> bool:
+        return self.client is not None and self.client.window > 0
+
     def submit(self, overrides: dict) -> int:
+        if self.pipelined:
+            return self.client.submit(overrides)
         with self.rpc_lock:
             return self.client.submit(overrides)
 
+    def result(self, rrid: int, timeout: float) -> dict:
+        """Poll one forwarded request's result over the SHARED
+        pipelined connection (many waiters multiplex; replies match by
+        seq and complete out-of-order)."""
+        return self.client.result(rrid, timeout=timeout)
+
     def stats(self) -> dict:
+        if self.pipelined:
+            return self.client.stats()
         with self.rpc_lock:
             return self.client.stats()
 
     def drain(self) -> dict:
+        if self.pipelined:
+            return self.client.drain()
         with self.rpc_lock:
             return self.client.drain()
 
@@ -158,6 +187,14 @@ class RouterService:
         self.persist_every_s = float(persist_every_s)
         self.replica_extra_args = tuple(replica_extra_args)
         self.pad_peers = bool(getattr(cfg, "sweep_pad_peers", 1))
+        # round 17: the router→replica hop rides ONE pipelined
+        # connection per replica (serve_inflight in-flight RPCs,
+        # seq-matched) instead of a per-forwarded-RPC connection —
+        # serve_pipeline=0 restores the PR 13 per-request-connection
+        # shape for old replicas
+        self.inner_window = (int(getattr(cfg, "serve_inflight", 32))
+                             if int(getattr(cfg, "serve_pipeline", 1))
+                             else 0)
         self.log = log
         self._lock = threading.Lock()
         self._sig_lock = threading.Lock()
@@ -337,7 +374,13 @@ class RouterService:
         redirect count).  A request whose replica dies mid-wait is
         re-admitted by recovery and this wait follows it to the
         survivor.  Raises KeyError / TimeoutError / ServeShed /
-        RuntimeError like the single server."""
+        RuntimeError like the single server.
+
+        Round 17: the wait polls over the replica's ONE pipelined
+        control connection — many concurrent waiters multiplex there,
+        matched by seq, completing out-of-order — instead of opening a
+        connection per waiting request (the pre-pipelining shape,
+        still taken when ``serve_pipeline=0``)."""
         deadline = (time.monotonic() + timeout) if timeout else None
         conn: ServeClient | None = None
         conn_key: tuple | None = None
@@ -369,24 +412,28 @@ class RouterService:
                 if not live or rrid is None:
                     time.sleep(0.05)     # recovery is re-routing it
                     continue
-                # one wire connection per waiting request (the
-                # single-server shape: one client, one socket) —
-                # re-opened when recovery moves the request
-                if conn is None or conn_key != (rep, gen):
-                    if conn is not None:
-                        conn.close()
-                    try:
-                        conn = ServeClient(
-                            "127.0.0.1", port,
-                            wire_format=self.cfg.wire_format,
-                            timeout=2.0, read_timeout=10.0, retries=0)
-                        conn_key = (rep, gen)
-                    except OSError:
-                        conn = None
-                        time.sleep(0.1)
-                        continue
+                if h.pipelined:
+                    poll = lambda: h.result(rrid, timeout=2.0)  # noqa: E731
+                else:
+                    # legacy replicas: one wire connection per waiting
+                    # request, re-opened when recovery moves it
+                    if conn is None or conn_key != (rep, gen):
+                        if conn is not None:
+                            conn.close()
+                        try:
+                            conn = ServeClient(
+                                "127.0.0.1", port,
+                                wire_format=self.cfg.wire_format,
+                                timeout=2.0, read_timeout=10.0,
+                                retries=0)
+                            conn_key = (rep, gen)
+                        except OSError:
+                            conn = None
+                            time.sleep(0.1)
+                            continue
+                    poll = lambda: conn.result(rrid, timeout=2.0)  # noqa: E731
                 try:
-                    raw = conn.result(rrid, timeout=2.0)
+                    raw = poll()
                 except TimeoutError:
                     continue            # still pending — poll again
                 except (ConnectionError, OSError):
@@ -530,7 +577,8 @@ class RouterService:
         try:
             client = ServeClient("127.0.0.1", port,
                                  wire_format=self.cfg.wire_format,
-                                 timeout=2.0, read_timeout=10.0)
+                                 timeout=2.0, read_timeout=10.0,
+                                 window=self.inner_window)
         except OSError:
             return                       # next poll retries
         with self._lock:

@@ -30,7 +30,28 @@ request      fields                                 response
                                                     snapshot, on demand)
 ``drain``    —                                      ``drained`` (stats),
                                                     then the server stops
+``hello``    —                                      ``hello`` (``pipeline``
+                                                    =1: the server echoes
+                                                    ``seq`` correlation
+                                                    ids — the round-17
+                                                    capability probe)
 ===========  =====================================  ====================
+
+**Wire pipelining (round 17).**  Any request document may carry a
+``seq`` field — an opaque per-connection correlation id the server
+echoes verbatim on the matching reply.  A document that carries one is
+demultiplexed onto its own handler (bounded per-connection window, so a
+hostile client cannot fork unbounded threads; past the window the
+document is handled inline, which back-pressures the read loop), so one
+connection carries many in-flight RPCs and replies complete
+out-of-order — a 600-second blocking ``result`` wait no longer
+serializes the submits behind it.  Documents WITHOUT ``seq`` take the
+classic read-one-reply-one path bit-for-bit (the PR 9 protocol), which
+is the whole version negotiation: old single-RPC clients never send
+``seq`` and never see one.  ``drain`` is always handled inline — it
+ends the server, so racing it against its own connection's in-flight
+handlers would make the final stats nondeterministic — but its reply
+still echoes ``seq`` so pipelined clients can match it.
 
 The server is a thin adapter: every decision (admission, backpressure,
 latency accounting, salvage) lives in :class:`serve.service
@@ -53,6 +74,10 @@ from p2p_gossipprotocol_tpu.transport.socket_transport import (
 
 class ServeServer:
     """Accept loop + per-connection handlers over a GossipService."""
+
+    #: max concurrently-demultiplexed in-flight RPCs per connection;
+    #: past it, documents are handled inline (back-pressure, not drop)
+    PIPELINE_WINDOW = 64
 
     def __init__(self, service, ip: str, port: int,
                  wire_format: str = "json", log=None):
@@ -146,13 +171,19 @@ class ServeServer:
     def _handle(self, conn: socket.socket) -> None:
         stream = self.stream_cls(conn)
         conn.settimeout(0.5)
+        # per-connection demux context: one write lock (replies from
+        # concurrent handlers must not interleave mid-document) and the
+        # bounded in-flight window
+        ctx = {"lock": threading.Lock(),
+               "sem": threading.Semaphore(self.PIPELINE_WINDOW),
+               "threads": []}
         try:
             while not self._stop.is_set():
                 docs = stream.recv_objects()
                 if docs is None:
                     return                       # client hung up
                 for doc in docs:
-                    if not self._dispatch(conn, doc):
+                    if not self._route(conn, doc, ctx):
                         return
         finally:
             try:
@@ -160,34 +191,68 @@ class ServeServer:
             except OSError:
                 pass
 
-    def _reply(self, conn, obj: dict) -> None:
+    def _route(self, conn, doc, ctx) -> bool:
+        """One document: demultiplex it onto its own handler when it
+        carries a ``seq`` correlation id (pipelined client) and the
+        per-connection window has room; otherwise handle inline — the
+        legacy read-one-reply-one path, also the back-pressure path
+        when the window is full.  ``drain`` is always inline (it ends
+        the server; see module docstring)."""
+        pipelined = (isinstance(doc, dict)
+                     and doc.get("seq") is not None
+                     and doc.get("type") != "drain")
+        if pipelined and ctx["sem"].acquire(blocking=False):
+            t = threading.Thread(target=self._dispatch_async,
+                                 args=(conn, doc, ctx), daemon=True)
+            t.start()
+            ctx["threads"] = [h for h in ctx["threads"]
+                              if h.is_alive()] + [t]
+            return True
+        return self._dispatch(conn, doc, ctx)
+
+    def _dispatch_async(self, conn, doc, ctx) -> None:
         try:
-            self.send(conn, obj)
+            self._dispatch(conn, doc, ctx)
+        finally:
+            ctx["sem"].release()
+
+    def _reply(self, conn, obj: dict, ctx=None, seq=None) -> None:
+        if seq is not None:
+            obj = {**obj, "seq": seq}
+        try:
+            if ctx is not None:
+                with ctx["lock"]:
+                    self.send(conn, obj)
+            else:
+                self.send(conn, obj)
         except OSError:
             pass
 
-    def _dispatch(self, conn, doc) -> bool:
+    def _dispatch(self, conn, doc, ctx=None) -> bool:
         """Handle one document; returns False when the connection (or
         the whole server, on drain) should end."""
         if not isinstance(doc, dict):
             self._reply(conn, {"type": "error",
-                               "reason": "requests are JSON objects"})
+                               "reason": "requests are JSON objects"},
+                        ctx)
             return True
         op = doc.get("type")
+        seq = doc.get("seq")
         if op == "submit":
             scenario = doc.get("scenario")
             if not isinstance(scenario, dict):
                 self._reply(conn, {"type": "rejected",
                                    "reason": "submit needs a "
-                                             "'scenario' object"})
+                                             "'scenario' object"},
+                            ctx, seq)
                 return True
             try:
                 rid = self.service.submit(scenario)
             except ServeReject as e:
                 self._reply(conn, {"type": "rejected",
-                                   "reason": e.reason})
+                                   "reason": e.reason}, ctx, seq)
                 return True
-            self._reply(conn, {"type": "accepted", "id": rid})
+            self._reply(conn, {"type": "accepted", "id": rid}, ctx, seq)
         elif op == "result":
             rid = doc.get("id")
             try:
@@ -196,58 +261,99 @@ class ServeServer:
             except KeyError:
                 self._reply(conn, {"type": "error",
                                    "reason": f"unknown request id "
-                                             f"{rid}"})
+                                             f"{rid}"}, ctx, seq)
                 return True
             except TimeoutError:
-                self._reply(conn, {"type": "pending", "id": int(rid)})
+                self._reply(conn, {"type": "pending", "id": int(rid)},
+                            ctx, seq)
                 return True
             except Exception as e:  # noqa: BLE001 — loop failure, surfaced
                 self._reply(conn, {"type": "error",
                                    "reason": f"{type(e).__name__}: "
-                                             f"{e}"})
+                                             f"{e}"}, ctx, seq)
                 return True
             self._reply(conn, {"type": "result", "id": int(rid),
-                               "row": row})
+                               "row": row}, ctx, seq)
         elif op == "stats":
             self._reply(conn, {"type": "stats",
-                               **self.service.stats()})
+                               **self.service.stats()}, ctx, seq)
+        elif op == "hello":
+            # capability probe (round 17): the reply's echoed ``seq``
+            # IS the negotiation — an old server answers the unknown-
+            # type error without one, and the client degrades to
+            # in-order reply matching (see ServeClient)
+            self._reply(conn, {"type": "hello", "pipeline": 1,
+                               "window": self.PIPELINE_WINDOW},
+                        ctx, seq)
         elif op == "metrics":
             from p2p_gossipprotocol_tpu import telemetry
 
             self._reply(conn, {"type": "metrics",
                                "text": telemetry.recorder()
-                               .render_metrics()})
+                               .render_metrics()}, ctx, seq)
         elif op == "flight":
             from p2p_gossipprotocol_tpu import telemetry
 
             self._reply(conn, {"type": "flight",
                                "snapshot": telemetry.recorder()
-                               .snapshot()})
+                               .snapshot()}, ctx, seq)
         elif op == "profile":
             try:
                 res = self.service.profile_capture(
                     duration_s=float(doc.get("duration_s", 2.0)),
                     top_n=int(doc.get("top_n", 20)))
             except ServeReject as e:
-                self._reply(conn, {"type": "error", "reason": e.reason})
+                self._reply(conn, {"type": "error", "reason": e.reason},
+                            ctx, seq)
                 return True
             except Exception as e:  # noqa: BLE001 — capture failed, say so
                 self._reply(conn, {"type": "error",
                                    "reason": f"profile capture failed: "
                                              f"{type(e).__name__}: "
-                                             f"{e}"})
+                                             f"{e}"}, ctx, seq)
                 return True
-            self._reply(conn, {"type": "profile", **res})
+            self._reply(conn, {"type": "profile", **res}, ctx, seq)
         elif op == "drain":
             stats = self.service.drain()
-            self._reply(conn, {"type": "drained", **stats})
+            self._reply(conn, {"type": "drained", **stats}, ctx, seq)
             self._stop.set()
             return False
         else:
             self._reply(conn, {"type": "error",
                                "reason": f"unknown request type "
-                                         f"{op!r}"})
+                                         f"{op!r}"}, ctx, seq)
         return True
+
+
+class PendingRpc:
+    """One in-flight pipelined RPC (round 17): created by the
+    ``*_async`` surface, resolved by the client's reader thread when
+    the matching reply arrives (out-of-order on a pipelining server),
+    awaited with :meth:`wait` — which applies the same parse/raise
+    rules the synchronous call would."""
+
+    def __init__(self, client, doc: dict, wait_s: float, parse=None):
+        self._client = client
+        self.doc = doc
+        self.wait_s = wait_s
+        self.reply: dict | None = None
+        self.error: Exception | None = None
+        self.abandoned = False           # waiter timed out; drop reply
+        self._released = False           # window slot given back once
+        self._event = threading.Event()
+
+        self._parse = parse
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self):
+        """Block for the reply (the RPC's declared server-side wait +
+        the client read timeout); returns the parsed value — exactly
+        what the synchronous call returns — or raises what it would
+        raise (ServeReject, TimeoutError, ConnectionError, ...)."""
+        resp = self._client._pipe_wait(self)
+        return resp if self._parse is None else self._parse(resp)
 
 
 class ServeClient:
@@ -278,7 +384,7 @@ class ServeClient:
     def __init__(self, ip: str, port: int, wire_format: str = "json",
                  timeout: float = 10.0, read_timeout: float = 30.0,
                  retries: int | None = None,
-                 backoff_s: float | None = None):
+                 backoff_s: float | None = None, window: int = 0):
         self.ip = ip
         self.port = port
         self.connect_timeout = timeout
@@ -290,6 +396,26 @@ class ServeClient:
         self.reconnects = 0              # transport-error reconnects
         self.sock: socket.socket | None = None
         self.stream = None
+        # -- pipelined mode (round 17): window > 0 arms the async
+        # submit/await surface — a bounded in-flight window of RPCs
+        # multiplexed over THIS one connection, replies matched by the
+        # ``seq`` correlation id the server echoes.  window = 0 is the
+        # untouched PR 9/13 single-RPC client, byte-for-byte.
+        self.window = int(window)
+        self._seq = 0
+        self._pending: dict[int, PendingRpc] = {}   # insertion-ordered
+        self._pipe_lock = threading.Lock()
+        self._send_lock = threading.Lock()
+        self._window_sem = (threading.BoundedSemaphore(self.window)
+                            if self.window > 0 else None)
+        self._reader: threading.Thread | None = None
+        self._armed = False
+        #: did the server echo ``seq``?  False after talking to an old
+        #: server: replies then match in send order (the old server
+        #: handles documents sequentially, so FIFO is exact), and a
+        #: blocking wait head-of-line blocks — degraded, never wrong.
+        self.seq_echo = False
+        self._closed = False
         self._connect()
 
     def _connect(self) -> None:
@@ -301,11 +427,195 @@ class ServeClient:
         self.sock.settimeout(0.5)
         self.stream = self._stream_cls(self.sock)
 
+    # -- pipelined mode (round 17) -------------------------------------
+    def _pipe_arm(self) -> None:
+        """First-use capability probe: one synchronous ``hello`` on the
+        raw socket (before the reader thread owns it).  A pipelining
+        server echoes the probe's ``seq`` — full out-of-order reply
+        matching; an old server answers the unknown-type error without
+        one — the client degrades to in-order matching (exact: the old
+        server handles one document at a time).  Either way the reader
+        thread starts and every later RPC multiplexes over this one
+        connection."""
+        with self._pipe_lock:
+            if self._armed and self.sock is not None:
+                return
+            if self.sock is None:
+                self._connect()
+            self.send(self.sock, {"type": "hello", "seq": -1})
+            deadline = time.monotonic() + self.read_timeout
+            doc = None
+            while doc is None:
+                docs = self.stream.recv_objects()
+                if docs is None:
+                    raise ConnectionError(
+                        "server closed during the pipeline hello")
+                if docs:
+                    doc = docs[0]
+                elif time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"no hello reply from {self.ip}:{self.port} "
+                        f"within {self.read_timeout:g}s")
+            self.seq_echo = (isinstance(doc, dict)
+                             and doc.get("type") == "hello"
+                             and doc.get("seq") == -1)
+            self._armed = True
+            self._reader = threading.Thread(target=self._pipe_reader,
+                                            daemon=True)
+            self._reader.start()
+
+    def _pipe_send(self, obj: dict, wait_s: float = 0.0,
+                   parse=None) -> PendingRpc:
+        """Stamp a fresh ``seq``, register the pending, send.  Blocks
+        while the in-flight window is full — the bounded-window
+        back-pressure the issue names, not an unbounded buffer."""
+        if self._closed:
+            raise ConnectionError("client is closed")
+        if not self._armed or self.sock is None:
+            self._pipe_arm()
+        self._window_sem.acquire()
+        with self._pipe_lock:
+            seq = self._seq
+            self._seq += 1
+            p = PendingRpc(self, {**obj, "seq": seq}, wait_s,
+                           parse=parse)
+            self._pending[seq] = p
+        try:
+            with self._send_lock:
+                if self.sock is None:
+                    raise ConnectionError("no connection")
+                self.send(self.sock, p.doc)
+        except (ConnectionError, OSError):
+            pass        # the reader's reconnect replays it (or fails it)
+        return p
+
+    def _pipe_wait(self, p: PendingRpc) -> dict:
+        """Await one pending reply.  A read-deadline expiry is NOT
+        retried (same rule as the synchronous path: the wire may be
+        healthy-but-slow; replaying could double-submit) — the pending
+        is abandoned and its eventual reply discarded."""
+        budget = p.wait_s + self.read_timeout
+        if not p._event.wait(budget):
+            with self._pipe_lock:
+                p.abandoned = True
+                if self.seq_echo:
+                    self._pending.pop(p.doc["seq"], None)
+            self._pipe_release(p)
+            raise TimeoutError(
+                f"no reply from {self.ip}:{self.port} within "
+                f"{budget:g}s")
+        if p.error is not None:
+            raise p.error
+        return p.reply
+
+    def _pipe_call(self, obj: dict, wait_s: float = 0.0) -> dict:
+        return self._pipe_wait(self._pipe_send(obj, wait_s))
+
+    def _pipe_release(self, p: PendingRpc) -> None:
+        with self._pipe_lock:
+            if p._released:
+                return
+            p._released = True
+        self._window_sem.release()
+
+    def _pipe_match(self, doc) -> None:
+        with self._pipe_lock:
+            if self.seq_echo:
+                seq = (doc.get("seq") if isinstance(doc, dict)
+                       else None)
+                p = self._pending.pop(seq, None)
+            else:
+                # in-order matching (old server): the oldest pending —
+                # dict preserves insertion order, abandoned entries
+                # included so the reply stream stays aligned
+                p = None
+                for k in self._pending:
+                    p = self._pending.pop(k)
+                    break
+            if p is None:
+                return                     # late reply to an abandoned RPC
+        if isinstance(doc, dict) and "seq" in doc:
+            doc = {k: v for k, v in doc.items() if k != "seq"}
+        p.reply = doc
+        self._pipe_release(p)
+        p._event.set()
+
+    def _pipe_reader(self) -> None:
+        while True:
+            with self._pipe_lock:
+                if self._closed:
+                    return
+                stream = self.stream
+            if stream is None:
+                return
+            docs = stream.recv_objects()
+            if docs is None:
+                if self._closed:
+                    return
+                if not self._pipe_reconnect():
+                    return
+                continue
+            for doc in docs:
+                self._pipe_match(doc)
+
+    def _pipe_reconnect(self) -> bool:
+        """Transport death with RPCs in flight: bounded
+        retry-with-backoff (the PR 13 discipline) — reconnect and
+        REPLAY every unanswered document in send order (each keeps its
+        ``seq``, so matching is unaffected; in FIFO mode the in-order
+        replay IS the alignment).  Abandoned pendings are dropped
+        first — their waiters already gave up, and in FIFO mode a
+        ghost entry would misalign every reply behind it.  The replay
+        keeps the protocol at-most-once-per-attempt, exactly like the
+        synchronous client: the fleet router de-duplicates by ITS
+        request id.  Returns False when the budget is exhausted —
+        every pending RPC then fails with ConnectionError."""
+        delay = self.backoff_s
+        for _attempt in range(self.retries + 1):
+            try:
+                with self._send_lock:
+                    if self.sock is not None:
+                        try:
+                            self.sock.close()
+                        except OSError:
+                            pass
+                    self._connect()
+                    with self._pipe_lock:
+                        for k in [k for k, q in self._pending.items()
+                                  if q.abandoned]:
+                            del self._pending[k]
+                        pend = list(self._pending.values())
+                    for p in pend:
+                        self.send(self.sock, p.doc)
+                self.reconnects += 1
+                return True
+            except (ConnectionError, OSError):
+                time.sleep(delay)
+                delay *= 2
+        err = ConnectionError(
+            f"pipelined connection to {self.ip}:{self.port} lost and "
+            f"not re-established after {self.retries + 1} attempt(s)")
+        with self._pipe_lock:
+            pend = list(self._pending.values())
+            self._pending.clear()
+        self.sock = None
+        self.stream = None
+        for p in pend:
+            p.error = err
+            self._pipe_release(p)
+            p._event.set()
+        return False
+
     def _rpc(self, obj: dict, wait_s: float = 0.0) -> dict:
         """Send one document, return its reply.  ``wait_s`` is the
         server-side wait the call declared (``result``'s blocking
         timeout) — added to the read deadline so a deliberately slow
-        reply is not misread as a dead wire."""
+        reply is not misread as a dead wire.  With ``window`` > 0 the
+        call multiplexes over the pipelined connection instead (same
+        parse/raise surface, same retry discipline — the reader thread
+        owns reconnect-and-replay there)."""
+        if self.window > 0:
+            return self._pipe_call(obj, wait_s)
         delay = self.backoff_s
         for attempt in range(self.retries + 1):
             sent = False
@@ -342,22 +652,57 @@ class ServeClient:
                 self.reconnects += 1
         raise ConnectionError("unreachable")       # loop always returns
 
-    def submit(self, scenario: dict) -> int:
-        """Submit one scenario; returns the request id or raises
-        :class:`ServeReject` with the server's reason."""
-        resp = self._rpc({"type": "submit", "scenario": scenario})
+    @staticmethod
+    def _parse_submit(resp: dict) -> int:
         if resp.get("type") == "accepted":
             return int(resp["id"])
         raise ServeReject(resp.get("reason", "rejected"))
 
-    def result(self, rid: int, timeout: float = 600.0) -> dict:
-        resp = self._rpc({"type": "result", "id": rid,
-                          "timeout": timeout}, wait_s=timeout)
+    @staticmethod
+    def _parse_result(resp: dict) -> dict:
         if resp.get("type") == "result":
             return resp["row"]
         if resp.get("type") == "pending":
-            raise TimeoutError(f"request {rid} still pending")
+            raise TimeoutError(
+                f"request {resp.get('id')} still pending")
         raise RuntimeError(resp.get("reason", str(resp)))
+
+    def submit(self, scenario: dict) -> int:
+        """Submit one scenario; returns the request id or raises
+        :class:`ServeReject` with the server's reason."""
+        return self._parse_submit(
+            self._rpc({"type": "submit", "scenario": scenario}))
+
+    def result(self, rid: int, timeout: float = 600.0) -> dict:
+        return self._parse_result(
+            self._rpc({"type": "result", "id": rid,
+                       "timeout": timeout}, wait_s=timeout))
+
+    # -- async submit/await surface (round 17; needs window > 0) -------
+    def _require_window(self, what: str) -> None:
+        if self.window <= 0:
+            raise ValueError(
+                f"{what} needs a pipelined client — construct "
+                "ServeClient(..., window=N) (serve_inflight)")
+
+    def submit_async(self, scenario: dict) -> PendingRpc:
+        """Pipelined submit: returns a :class:`PendingRpc` immediately
+        (blocking only while the bounded in-flight window is full);
+        ``.wait()`` yields the request id or raises ServeReject."""
+        self._require_window("submit_async")
+        return self._pipe_send({"type": "submit", "scenario": scenario},
+                               parse=self._parse_submit)
+
+    def result_async(self, rid: int,
+                     timeout: float = 600.0) -> PendingRpc:
+        """Pipelined result wait: many of these ride one connection
+        concurrently, completing out-of-order as scenarios converge;
+        ``.wait()`` yields the results row (or raises TimeoutError /
+        the failure, like the synchronous call)."""
+        self._require_window("result_async")
+        return self._pipe_send({"type": "result", "id": rid,
+                                "timeout": timeout}, wait_s=timeout,
+                               parse=self._parse_result)
 
     def stats(self) -> dict:
         return self._rpc({"type": "stats"})
@@ -392,6 +737,7 @@ class ServeClient:
         return self._rpc({"type": "drain"}, wait_s=wait_s)
 
     def close(self) -> None:
+        self._closed = True
         if self.sock is not None:
             try:
                 self.sock.close()
@@ -399,3 +745,7 @@ class ServeClient:
                 pass
         self.sock = None
         self.stream = None
+        reader, self._reader = self._reader, None
+        if reader is not None and reader is not threading.current_thread():
+            reader.join(timeout=1)
+        self._armed = False

@@ -91,22 +91,89 @@ class Occupant:
 
 class ServeBucket:
     """A resident, slot-swappable bucket: one compiled chunk program
-    serving a rotating population of signature-identical scenarios."""
+    PER WIDTH serving a rotating population of signature-identical
+    scenarios.  Round 17 made the width dynamic: :meth:`resize` swaps
+    the batch onto a different power-of-two slot count, migrating live
+    occupants bit-for-bit through the admit scatter; per-width
+    :class:`FleetBucket`\\ s are cached, so returning to a width the
+    bucket has served before compiles nothing."""
+
+    _next_uid = 0
 
     def __init__(self, template_spec, slots: int, chunk: int,
                  target: float):
         from p2p_gossipprotocol_tpu.fleet.packer import bucket_signature
 
+        #: stable identity for the autoscaler's streak/cooldown state
+        #: and the ``autoscale`` ledger events
+        self.uid = ServeBucket._next_uid
+        ServeBucket._next_uid += 1
         self.template_spec = template_spec
-        self.fleet = FleetBucket.for_serving(template_spec.sim, slots)
         self.slots = slots
         self.chunk = chunk
         self.target = target
         self.signature = bucket_signature(template_spec.sim)
+        #: one FleetBucket (and thus one chunk-program compile cache)
+        #: per width this bucket has ever run at — a shrink-then-grow
+        #: cycle re-uses the old program instead of retracing
+        self._fleets: dict[int, FleetBucket] = {}
+        self.fleet = self._fleet_for(slots)
         self.state, self.topo, self.done = self.fleet.init_idle()
         self.seeds = self.fleet._seeds
         self.srcs = self.fleet._srcs
         self.occupants: list[Occupant | None] = [None] * slots
+        #: (width, chunk-length) pairs dispatched — the EXPECTED trace
+        #: count: each pair compiles exactly once, nothing else may
+        #: (the zero-admission-recompile ledger, now resize-aware)
+        self._programs: set = set()
+        #: chunk retraces observed during admit/resize scatters — the
+        #: direct spelling of the PR 9 acceptance gate, asserted == 0
+        self.admission_recompiles = 0
+        self.resizes = 0
+
+    def _fleet_for(self, slots: int) -> FleetBucket:
+        if slots not in self._fleets:
+            self._fleets[slots] = FleetBucket.for_serving(
+                self.template_spec.sim, slots)
+        return self._fleets[slots]
+
+    # ------------------------------------------------------------------
+    def park(self) -> None:
+        """Move an idle bucket to the service's parking lot state
+        (round 17): compiled per-width programs AND the inert batch
+        arrays are kept — the PR 13 plane recompiled a bucket's chunk
+        program on every signature re-miss, which under
+        signature-diverse traffic is a compile per eviction cycle, the
+        hidden half of the ~4 QPS knee.  Keeping the arrays is bitwise
+        safe BY the retirement contract: every slot of an idle bucket
+        is done-frozen (its stale world computes-and-discards under
+        the convergence mask, and only occupied slots' metrics are
+        ever read), so the next admission scatters a fresh world over
+        it exactly as it would over the init_idle template.  Memory is
+        bounded by the lot's LRU cap — a dropped bucket frees
+        everything.  Only an idle bucket may park."""
+        if self.live():
+            raise ValueError("cannot park a bucket with live occupants")
+        self.occupants = [None] * self.slots
+
+    def unpark(self) -> None:
+        """Re-arm a parked bucket: the resident batch is already
+        all-done-inert and the programs are warm — reopening a
+        signature family costs NOTHING but the admit scatter, never a
+        retrace (asserted by the (width, chunk) program ledger)."""
+        assert not self.live()
+
+    # -- trace accounting ----------------------------------------------
+    def trace_total(self) -> int:
+        """Chunk retraces across every width this bucket has run at."""
+        return sum(f.trace_count for f in self._fleets.values())
+
+    def expected_traces(self) -> int:
+        """What :meth:`trace_total` must equal on a healthy bucket:
+        one compile per distinct (width, chunk-length) program ever
+        dispatched.  Anything above is a real recompile — an admission
+        or migration that changed the traced program."""
+        return len(self._programs)
 
     # ------------------------------------------------------------------
     def free_slots(self) -> list[int]:
@@ -114,6 +181,62 @@ class ServeBucket:
 
     def live(self) -> bool:
         return any(o is not None for o in self.occupants)
+
+    def live_count(self) -> int:
+        return sum(o is not None for o in self.occupants)
+
+    # ------------------------------------------------------------------
+    def resize(self, new_slots: int) -> None:
+        """Move the resident batch to ``new_slots`` slots (round 17's
+        autoscale primitive, round-boundary only).  Live occupants
+        migrate through the existing scatter machinery: each one's
+        current world is read out of the old batch
+        (``FleetBucket.extract_slot_payload``) and admitted into the
+        new one — state, PRNG chain, rewired lanes, liveness seed and
+        stagger row carried bit-for-bit, so every migrated scenario's
+        remaining trajectory is unchanged (its slot INDEX may change;
+        nothing the round computes reads it).  Occupant ledgers
+        (rounds/converged/hist) ride the Occupant objects untouched."""
+        import os as _os
+        import signal as _signal
+
+        live = [(s, o) for s, o in enumerate(self.occupants)
+                if o is not None]
+        if new_slots < 1:
+            raise ValueError("resize needs >= 1 slot")
+        if len(live) > new_slots:
+            raise ValueError(
+                f"cannot resize to {new_slots} slots with "
+                f"{len(live)} live occupants")
+        if new_slots == self.slots:
+            return
+        old_fleet, old = self.fleet, (self.state, self.topo,
+                                      self.seeds, self.srcs)
+        payloads = [old_fleet.extract_slot_payload(
+            old[0], old[1], old[2], old[3], s) for s, _ in live]
+        traces_before = self.trace_total()
+        self.fleet = self._fleet_for(new_slots)
+        if _os.environ.get("GOSSIP_SERVE_KILL") == "resize":
+            # deterministic chaos seam (the GOSSIP_CKPT_KILL
+            # precedent): die MID-resize, after the new batch exists
+            # but before the occupants migrate — the worst torn
+            # window.  Recovery must come from the last persisted
+            # manifest, never from this half-moved in-memory state.
+            _os.kill(_os.getpid(), _signal.SIGKILL)
+        self.state, self.topo, self.done = self.fleet.init_idle()
+        self.seeds = self.fleet._seeds
+        self.srcs = self.fleet._srcs
+        new_occ: list[Occupant | None] = [None] * new_slots
+        for j, ((_s, occ), payload) in enumerate(zip(live, payloads)):
+            (self.state, self.topo, self.done, self.seeds,
+             self.srcs) = self.fleet.admit_into(
+                self.state, self.topo, self.done, self.seeds,
+                self.srcs, j, payload=payload)
+            new_occ[j] = occ
+        self.occupants = new_occ
+        self.slots = new_slots
+        self.resizes += 1
+        self.admission_recompiles += self.trace_total() - traces_before
 
     # ------------------------------------------------------------------
     def admit(self, req: Request, slot: int | None = None) -> int:
@@ -133,10 +256,12 @@ class ServeBucket:
             payload = self.fleet.admit_args(req.spec.sim)
         else:
             req._staged_payload = None
+        traces_before = self.trace_total()
         (self.state, self.topo, self.done, self.seeds,
          self.srcs) = self.fleet.admit_into(
             self.state, self.topo, self.done, self.seeds, self.srcs,
             slot, payload=payload)
+        self.admission_recompiles += self.trace_total() - traces_before
         self.occupants[slot] = Occupant(req=req)
         return slot
 
@@ -165,6 +290,9 @@ class ServeBucket:
         async — the returned metric arrays are futures until
         device_get)."""
         step = self.chunk if step is None else step
+        # the expected-trace ledger: this (width, length) program
+        # compiles at most once — see expected_traces()
+        self._programs.add((self.slots, step))
         fn = self.fleet._chunk_fn(step, self.target)
         (self.state, self.topo, self.done, ys, dhist) = fn(
             self.state, self.topo, self.done, self.seeds, self.srcs)
@@ -225,13 +353,17 @@ class GossipService:
     (the ``wrapper.Peer`` lifecycle shape, serving many scenarios
     instead of embodying one peer)."""
 
+    #: minimum seconds between autoscale control ticks (see _last_tick)
+    AUTOSCALE_TICK_S = 0.2
+
     def __init__(self, cfg, n_peers: int | None = None, *,
                  slots: int | None = None, queue_max: int | None = None,
                  max_buckets: int | None = None, chunk: int | None = None,
                  target: float | None = None, rounds: int | None = None,
                  checkpoint_dir: str | None = None,
                  results_path: str | None = None, resume: bool = False,
-                 persist_every_s: float = 0.0, log=None):
+                 persist_every_s: float = 0.0,
+                 autoscale: bool | None = None, log=None):
         from p2p_gossipprotocol_tpu.engines import probe_backend
 
         probe_backend()
@@ -255,6 +387,46 @@ class GossipService:
                 slots=self.slots, rounds=self.rounds)
         self.checkpoint_dir = checkpoint_dir or cfg.checkpoint_dir or None
         self.results_path = results_path or cfg.serve_results or None
+        # telemetry-driven autoscaling (round 17): the control loop
+        # consumes the exact occupancy/queue-depth values the PR 10
+        # gauges publish and resizes the fleet's shape under load —
+        # power-of-two slot-width grow/shrink per bucket plus
+        # open/close under serve_max_buckets, with hysteresis (the
+        # policy lives jax-free in serve/autoscale.py).
+        from p2p_gossipprotocol_tpu.serve.autoscale import Autoscaler
+
+        self.autoscale = bool(getattr(cfg, "serve_autoscale", 0)
+                              if autoscale is None else autoscale)
+        self.autoscaler = Autoscaler(
+            min_slots=int(getattr(cfg, "serve_autoscale_min", 1)),
+            max_slots=int(getattr(cfg, "serve_autoscale_max", 64)),
+            hold=int(getattr(cfg, "serve_autoscale_hold", 3)))
+        self.autoscale_events = 0
+        #: widest slot width any bucket reached (high-water mark — the
+        #: bench/measurement rows record it; the instantaneous min/max
+        #: can already have shrunk back by the time a row lands)
+        self.slot_width_peak = 0
+        #: the parking lot (autoscale mode): closed/evicted buckets
+        #: keep their compiled per-width programs here, keyed by
+        #: signature, so a returning signature family reopens with an
+        #: init_idle instead of a retrace.  Bounded (LRU): programs
+        #: for long-gone families are dropped, oldest first.
+        self._parked: dict = {}
+        self._parked_cap = max(16, 2 * self.max_buckets)
+        #: trace ledger of buckets that left entirely (discarded on
+        #: eviction with autoscale off, or LRU-dropped from the lot):
+        #: the recompile metrics are CUMULATIVE — compile work must
+        #: not disappear from the row when the bucket that paid it
+        #: does (an eviction-churn plane would otherwise report the
+        #: same retrace count as a warm one)
+        self._retired = {"traces": 0, "expected": 0, "admissions": 0}
+        #: control-loop tick floor: observations are sampled at most
+        #: every AUTOSCALE_TICK_S, so the hold hysteresis is a WALL
+        #: time (hold * tick floor), not an iteration count that
+        #: shrinks with chunk latency — an idle loop spinning at 50
+        #: iterations/s must not close a bucket 60 ms after its last
+        #: occupant retired
+        self._last_tick = 0.0
         # periodic persistence (serve-fleet replicas): the salvage
         # snapshot a SIGTERM writes once is refreshed every N seconds
         # at a chunk boundary, so even a SIGKILL — which runs no
@@ -400,13 +572,31 @@ class GossipService:
         reference assignment — readers see the old dict or the new one,
         never a half-mutated bucket list).  Called only by the thread
         that currently owns the buckets."""
+        widths = [b.slots for b in self.buckets]
+        self.slot_width_peak = max([self.slot_width_peak] + widths)
+        # parked buckets keep their trace history — the recompile
+        # ledger must not forget a bucket just because it is idle
+        every = list(self.buckets) + list(self._parked.values())
         self._occupancy = {
             "buckets": len(self.buckets),
-            "slots": sum(b.slots for b in self.buckets),
+            "slots": sum(widths),
             "slots_free": sum(len(b.free_slots())
                               for b in self.buckets),
-            "chunk_retraces": sum(b.fleet.trace_count
-                                  for b in self.buckets),
+            "chunk_retraces": (sum(b.trace_total() for b in every)
+                               + self._retired["traces"]),
+            # round 17: the resize-aware zero-recompile ledger — the
+            # Poisson harness asserts admission_recompiles == 0 and
+            # chunk_retraces == expected_retraces on every row
+            "expected_retraces": (sum(b.expected_traces()
+                                      for b in every)
+                                  + self._retired["expected"]),
+            "admission_recompiles": (sum(b.admission_recompiles
+                                         for b in every)
+                                     + self._retired["admissions"]),
+            "autoscale_events": self.autoscale_events,
+            "slot_width_min": min(widths) if widths else 0,
+            "slot_width_max": max(widths) if widths else 0,
+            "slot_width_peak": self.slot_width_peak,
         }
         # /metrics gauges mirror the snapshot (no-ops when telemetry
         # is off)
@@ -415,6 +605,10 @@ class GossipService:
                             self._occupancy["slots_free"])
         telemetry.gauge_set("serve_queue_depth",
                             len(self.scheduler.queue))
+        telemetry.gauge_set("serve_slot_width_min",
+                            self._occupancy["slot_width_min"])
+        telemetry.gauge_set("serve_slot_width_max",
+                            self._occupancy["slot_width_max"])
 
     def stats(self) -> dict:
         """The ``/stats`` payload: scheduler ledger + resident-bucket
@@ -497,10 +691,34 @@ class GossipService:
         return self.stats()
 
     # -- the serving loop ----------------------------------------------
+    def _retire_ledger(self, b: ServeBucket) -> None:
+        self._retired["traces"] += b.trace_total()
+        self._retired["expected"] += b.expected_traces()
+        self._retired["admissions"] += b.admission_recompiles
+
+    def _park(self, b: ServeBucket) -> None:
+        """Autoscale mode: retire an idle bucket into the parking lot
+        (compiled programs kept, batch arrays released); without the
+        control loop, discard — the PR 13 behavior, preserved so the
+        A/B axes stay independent.  Either way the bucket's compile
+        ledger survives (``_retire_ledger``)."""
+        if not self.autoscale:
+            self._retire_ledger(b)
+            return
+        b.park()
+        self._parked.pop(b.signature, None)   # refresh LRU position
+        self._parked[b.signature] = b
+        while len(self._parked) > self._parked_cap:
+            oldest = next(iter(self._parked))
+            self._retire_ledger(self._parked[oldest])
+            del self._parked[oldest]
+
     def _bucket_for(self, req: Request) -> ServeBucket | None:
         """Routing: same-signature bucket with a free slot, else a new
-        bucket (evicting an all-idle one when at the cap), else None
-        (the request keeps waiting)."""
+        bucket (evicting — parking, in autoscale mode — an all-idle
+        one when at the cap), else None (the request keeps waiting).
+        A parked bucket for the signature reopens warm: one
+        init_idle, zero retraces (round 17)."""
         for b in self.buckets:
             if b.signature == req.signature and b.free_slots():
                 return b
@@ -509,6 +727,16 @@ class GossipService:
             if not idle:
                 return None
             self.buckets.remove(idle[0])
+            self._park(idle[0])
+        parked = self._parked.pop(req.signature, None)
+        if parked is not None:
+            parked.unpark()
+            self.buckets.append(parked)
+            if self.log:
+                self.log(f"[serve] reopened parked bucket "
+                         f"{parked.uid} ({parked.slots} slots, warm "
+                         f"programs) for request {req.rid}")
+            return parked
         b = ServeBucket(req.spec, self.slots, self.chunk, self.target)
         self.buckets.append(b)
         if self.log:
@@ -536,6 +764,49 @@ class GossipService:
                 self.log(f"[serve] request {req.rid} -> bucket "
                          f"{self.buckets.index(b)} slot {slot}")
         return n
+
+    def _autoscale_tick(self) -> int:
+        """One control-loop tick (round-boundary only — the loop owns
+        the buckets here): feed the policy the same occupancy/queue-
+        depth signals the gauges publish, apply its decisions through
+        the slot-swap machinery, ledger each one as a typed
+        ``autoscale`` event.  Returns the number of applied actions
+        (the loop re-runs admission after a grow so fresh slots take
+        waiters in the same tick)."""
+        from p2p_gossipprotocol_tpu.serve.autoscale import \
+            BucketObservation
+
+        qd: dict = {}
+        for req in self.scheduler.queued():
+            qd[req.signature] = qd.get(req.signature, 0) + 1
+        obs = [BucketObservation(
+            uid=b.uid, slots=b.slots, live=b.live_count(),
+            queue_depth=qd.get(b.signature, 0)) for b in self.buckets]
+        applied = 0
+        for d in self.autoscaler.observe(obs):
+            b = next((x for x in self.buckets if x.uid == d.bucket),
+                     None)
+            if b is None:
+                continue
+            if d.action == "close":
+                self.buckets.remove(b)
+                self._park(b)
+                self.autoscaler.forget(b.uid)
+            else:
+                b.resize(d.to_slots)
+            applied += 1
+            self.autoscale_events += 1
+            telemetry.event("autoscale", action=d.action,
+                            bucket=d.bucket, from_slots=d.from_slots,
+                            to_slots=d.to_slots, occupancy=d.occupancy,
+                            queue_depth=d.queue_depth)
+            telemetry.counter_add("serve_autoscale_total")
+            if self.log:
+                self.log(f"[serve] autoscale {d.action}: bucket "
+                         f"{d.bucket} {d.from_slots} -> "
+                         f"{d.to_slots} slots (live {d.occupancy}, "
+                         f"queued {d.queue_depth})")
+        return applied
 
     def _stage_pending(self) -> None:
         """While chunks execute: pre-stage admission payloads for
@@ -597,6 +868,16 @@ class GossipService:
                     self.salvaged = True
                     return
                 self._admit_pending()
+                now = time.perf_counter()
+                if self.autoscale \
+                        and now - self._last_tick \
+                        >= self.AUTOSCALE_TICK_S:
+                    self._last_tick = now
+                    if self._autoscale_tick():
+                        # a grow frees capacity NOW — admit into it
+                        # before dispatching, so the waiters it was
+                        # grown for ride this very chunk
+                        self._admit_pending()
                 self._publish_occupancy()
                 active = [b for b in self.buckets if b.live()]
                 if not active:

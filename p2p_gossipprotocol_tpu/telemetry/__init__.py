@@ -31,7 +31,7 @@ from p2p_gossipprotocol_tpu.telemetry.roofline import RooflineTracker
 __all__ = ["Recorder", "RooflineTracker", "classify_clamp",
            "configure_from_config", "env_enabled", "recorder",
            "record_clamps", "event", "span", "counter_add", "gauge_set",
-           "dump"]
+           "gauge_get", "dump"]
 
 
 # module-level conveniences over the process singleton — call sites
@@ -54,6 +54,10 @@ def counter_add(name, value=1.0):
 
 def gauge_set(name, value):
     recorder().gauge_set(name, value)
+
+
+def gauge_get(name, default=None):
+    return recorder().gauge_get(name, default)
 
 
 def dump(reason, directory=None, path=None):

@@ -280,6 +280,16 @@ class Recorder:
         with self._lock:
             self._gauges[name] = float(value)
 
+    def gauge_get(self, name: str, default: float | None = None
+                  ) -> float | None:
+        """Read one gauge back (round 17: the autoscale tests and
+        operators verify the serving loop's published signals this
+        way; the control loop itself is fed the same values directly
+        at the publish site, so its decisions do not change when
+        telemetry is disabled and gauges go stale)."""
+        with self._lock:
+            return self._gauges.get(name, default)
+
     def counters(self) -> dict:
         """Snapshot of counters + gauges (one dict; gauges win on a
         name collision, which the catalog avoids by convention:

@@ -182,8 +182,11 @@ class RooflineTracker:
         if self.wall_s > 0:
             gbs = self.model_bytes / self.wall_s / 1e9
             rec.gauge_set("achieved_gb_s", round(gbs, 4))
+            # 10 places, not 6: on a loaded host a real-but-tiny
+            # fraction must not round to an impossible exact 0.0
+            # (a positive achieved_gb_s implies a positive fraction)
             rec.gauge_set("roofline_frac",
-                          round(gbs / self.roof_gb_s, 6))
+                          round(gbs / self.roof_gb_s, 10))
         if self.model_bytes > 0:
             drift = 1.0 - self.census_bytes / self.model_bytes
             rec.gauge_set("model_drift_frac", round(drift, 6))

@@ -210,6 +210,36 @@ def test_bench_serve_columns():
     assert rec["serve_wall_s"] > 0
     expect = rec["serve_n"] / rec["serve_wall_s"]
     assert abs(rec["serve_qps"] - expect) <= 1e-3 + 0.01 * expect
+    # round-17 columns ride every serve row, self-describing: knobs
+    # off -> facade (inflight 0) at the fixed provisioned width
+    assert rec["serve_inflight"] == 0
+    assert rec["autoscale_events"] == 0
+    assert rec["slot_width_min"] == rec["slot_width_max"] == 4
+
+
+@pytest.mark.slow
+def test_bench_serve_pipeline_autoscale_columns():
+    """Round-17 serving columns: GOSSIP_BENCH_SERVE_INFLIGHT drives
+    the burst over the wire through one pipelined client (the window
+    lands on the row) and GOSSIP_BENCH_SERVE_AUTOSCALE lets the
+    slot-width loop resize under it — autoscale_events and the
+    high-water slot_width_max record what it did, artifact-only
+    reproducible like every serving column."""
+    proc, rec = _run({"GOSSIP_BENCH_PLATFORM": "cpu",
+                      "JAX_PLATFORMS": "cpu",
+                      "GOSSIP_BENCH_SERVE": "8",
+                      "GOSSIP_BENCH_SERVE_PEERS": "16384",
+                      "GOSSIP_BENCH_SERVE_SLOTS": "1",
+                      "GOSSIP_BENCH_SERVE_INFLIGHT": "8",
+                      "GOSSIP_BENCH_SERVE_AUTOSCALE": "1"})
+    assert proc.returncode == 0, proc.stderr
+    assert rec["serve_inflight"] == 8
+    assert rec["serve_n"] == 8 and rec["serve_qps"] > 0
+    # an 8-request burst into a ONE-slot bucket is queue pressure by
+    # construction (only one scenario can run while seven wait): the
+    # control loop must have grown at least once
+    assert rec["autoscale_events"] >= 1
+    assert rec["slot_width_max"] > 1 and rec["slot_width_min"] >= 0
 
 
 def test_bench_stagger_and_block_perm_knobs():

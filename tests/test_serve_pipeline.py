@@ -1,0 +1,327 @@
+"""Wire pipelining (round 17): seq-correlated multi-RPC connections.
+
+Module name contains "serve", so conftest's per-test SIGALRM guard
+covers the socket tests automatically.
+
+The matrix the issue names:
+
+* **interleave / out-of-order** — one pipelined connection carries many
+  in-flight RPCs; a blocking ``result`` wait no longer serializes the
+  documents behind it, and replies complete in convergence order, not
+  send order;
+* **legacy client** — a ``window=0`` client (the PR 9/13 single-RPC
+  protocol, byte-for-byte) works unchanged against the demultiplexing
+  server, concurrently with pipelined clients on the same socket;
+* **legacy server** — a pipelined client probing an old server (no
+  ``hello``, no seq echo) degrades to exact in-order matching instead
+  of breaking: version negotiation is the probe's echoed ``seq``;
+* the bounded in-flight window back-pressures (blocks) instead of
+  buffering without limit, and the PR 13 transport-retry discipline
+  (reconnect + replay, bounded, backoff) carries over to the
+  pipelined connection.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from p2p_gossipprotocol_tpu.config import NetworkConfig
+from p2p_gossipprotocol_tpu.serve import GossipService, ServeReject
+from p2p_gossipprotocol_tpu.serve.server import ServeClient, ServeServer
+from p2p_gossipprotocol_tpu.transport.socket_transport import JsonStream
+
+BASE_CFG = """\
+127.0.0.1:8000
+backend=jax
+n_peers=1024
+n_messages=16
+avg_degree=8
+rounds=32
+"""
+
+
+@pytest.fixture(scope="module")
+def base_cfg(tmp_path_factory):
+    p = tmp_path_factory.mktemp("serve_pipe") / "network.txt"
+    p.write_text(BASE_CFG)
+    return NetworkConfig(str(p))
+
+
+def _server(base_cfg, **kw):
+    svc = GossipService(base_cfg, slots=4, target=0.99, rounds=64,
+                        **kw)
+    return ServeServer(svc, "127.0.0.1", 0).start()
+
+
+# ---------------------------------------------------------------------
+# interleave / out-of-order completion
+
+def test_pipelined_interleave_and_out_of_order(base_cfg):
+    """One connection, many in-flight RPCs: a long blocking ``result``
+    wait for a NOT-YET-SUBMITTED id must not stall the submits behind
+    it (the single-RPC wire would wedge here: read-one-reply-one), and
+    result waits issued in one order complete in another."""
+    server = _server(base_cfg)
+    try:
+        c = ServeClient("127.0.0.1", server.port, window=8)
+        rid0 = c.submit({"prng_seed": 0})    # sync over the pipe
+        assert c.seq_echo, "new server must echo seq"
+        # park a long blocking wait on the wire...
+        blocked = c.result_async(rid0, timeout=120)
+        # ...and interleave control traffic + submits behind it
+        st = c.stats()
+        assert st["type"] == "stats"
+        subs = [c.submit_async({"prng_seed": s}) for s in range(1, 5)]
+        rids = [s.wait() for s in subs]
+        assert sorted([rid0] + rids) == list(range(5))
+        # waits issued newest-first; completion order is the server's
+        waits = [c.result_async(r, timeout=120) for r in rids]
+        rows = [w.wait() for w in reversed(waits)]
+        assert {r["request"] for r in rows} == set(rids)
+        row0 = blocked.wait()
+        assert row0["request"] == rid0 and row0["converged"]
+        drained = c.drain()
+        assert drained["type"] == "drained" and drained["done"] == 5
+        c.close()
+    finally:
+        server.stop()
+
+
+def test_legacy_client_and_pipelined_client_coexist(base_cfg):
+    """The version-negotiation contract: an old single-RPC client
+    (window=0 — the exact PR 9 code path) keeps working against the
+    demultiplexing server, even while a pipelined client multiplexes
+    on its own connection."""
+    server = _server(base_cfg)
+    try:
+        legacy = ServeClient("127.0.0.1", server.port)          # old
+        piped = ServeClient("127.0.0.1", server.port, window=4)  # new
+        pends = [piped.submit_async({"prng_seed": s})
+                 for s in range(2)]
+        lrid = legacy.submit({"prng_seed": 9})
+        prids = [p.wait() for p in pends]
+        lrow = legacy.result(lrid, timeout=120)
+        assert lrow["request"] == lrid and lrow["converged"]
+        for r in prids:
+            assert piped.result(r, timeout=120)["converged"]
+        assert legacy.stats()["done"] == 3
+        legacy.close()
+        piped.close()
+        # legacy replies never carry seq (old clients would choke on
+        # an unexpected field only if they parsed it — but the
+        # contract is stronger: the path is byte-identical)
+        raw = socket.create_connection(("127.0.0.1", server.port),
+                                       timeout=5)
+        raw.sendall(json.dumps({"type": "stats"}).encode())
+        stream = JsonStream(raw)
+        docs = []
+        deadline = time.time() + 10
+        while not docs and time.time() < deadline:
+            got = stream.recv_objects()
+            assert got is not None
+            docs = got
+        assert docs and "seq" not in docs[0]
+        raw.close()
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------
+# legacy-server negotiation + window/retry mechanics (stub server —
+# jax-free, so the wire contract is tested in isolation)
+
+class _StubServer:
+    """A deliberately OLD-protocol server: sequential, replies without
+    seq, answers ``hello`` with the unknown-type error — plus knobs to
+    hold replies (window tests) and kill connections (retry tests)."""
+
+    def __init__(self, kill_after: int = 0):
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.port = self.sock.getsockname()[1]
+        self.kill_after = kill_after      # kill conn after N docs
+        self.hold = threading.Event()     # set = answer; clear = stall
+        self.hold.set()
+        self.seen = []
+        self._stop = False
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        stream = JsonStream(conn)
+        conn.settimeout(0.25)
+        n = 0
+        rid = [100]
+        while not self._stop:
+            docs = stream.recv_objects()
+            if docs is None:
+                return
+            for doc in docs:
+                self.seen.append(doc)
+                n += 1
+                if self.kill_after and n >= self.kill_after:
+                    self.kill_after = 0   # only the first connection
+                    conn.close()
+                    return
+                self.hold.wait(30)
+                op = doc.get("type")
+                if op == "submit":
+                    rid[0] += 1
+                    out = {"type": "accepted", "id": rid[0]}
+                elif op == "result":
+                    out = {"type": "result", "id": doc["id"],
+                           "row": {"request": doc["id"]}}
+                elif op == "stats":
+                    out = {"type": "stats", "done": 0}
+                else:       # hello included: the old-server answer
+                    out = {"type": "error",
+                           "reason": f"unknown request type "
+                                     f"{op!r}"}
+                try:
+                    conn.sendall(json.dumps(out).encode())
+                except OSError:
+                    return
+
+    def stop(self):
+        self._stop = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def test_pipelined_client_degrades_on_old_server():
+    """Negotiation: the hello probe comes back WITHOUT a seq echo, the
+    client records seq_echo=False and matches replies in order — every
+    RPC still completes correctly against the sequential old server."""
+    stub = _StubServer()
+    try:
+        c = ServeClient("127.0.0.1", stub.port, window=4,
+                        read_timeout=10.0)
+        pends = [c.submit_async({"prng_seed": s}) for s in range(3)]
+        rids = [p.wait() for p in pends]
+        assert not c.seq_echo
+        assert rids == [101, 102, 103]    # FIFO-exact
+        assert c.result(rids[0], timeout=5)["request"] == rids[0]
+        c.close()
+    finally:
+        stub.stop()
+
+
+def test_window_bounds_inflight_rpcs():
+    """The in-flight window is a bound, not a buffer: with the server
+    stalled, window=2 admits exactly two RPCs onto the wire and the
+    third BLOCKS until a reply frees a slot."""
+    stub = _StubServer()
+    try:
+        c = ServeClient("127.0.0.1", stub.port, window=2,
+                        read_timeout=30.0)
+        c.stats()                       # arm + drain the hello probe
+        stub.hold.clear()               # stall replies
+        p1 = c.submit_async({"prng_seed": 1})
+        p2 = c.submit_async({"prng_seed": 2})
+        third_sent = threading.Event()
+        pend3 = []
+
+        def third():
+            pend3.append(c.submit_async({"prng_seed": 3}))
+            third_sent.set()
+
+        t = threading.Thread(target=third, daemon=True)
+        t.start()
+        assert not third_sent.wait(0.4), \
+            "third RPC went out past the window=2 bound"
+        stub.hold.set()                 # replies flow; slots free
+        assert third_sent.wait(10)
+        assert sorted(p.wait() for p in [p1, p2] + pend3) \
+            == [101, 102, 103]
+        c.close()
+    finally:
+        stub.stop()
+
+
+def test_pipelined_reconnect_replays_pending():
+    """The PR 13 transport-retry discipline on the pipelined wire: a
+    connection killed with RPCs in flight is re-established (bounded,
+    backed off) and the unanswered documents are REPLAYED — the caller
+    just sees its reply arrive."""
+    stub = _StubServer(kill_after=2)    # hello + first doc, then RST
+    try:
+        c = ServeClient("127.0.0.1", stub.port, window=4,
+                        read_timeout=10.0, retries=3)
+        p = c.submit_async({"prng_seed": 1})
+        assert p.wait() == 101
+        assert c.reconnects >= 1
+        # the replayed document is byte-identical (same seq)
+        submits = [d for d in stub.seen if d.get("type") == "submit"]
+        assert len(submits) >= 2 and submits[0] == submits[1]
+        c.close()
+    finally:
+        stub.stop()
+
+
+def test_pipelined_retry_budget_exhaustion_raises():
+    """A server that dies for good: every pending RPC fails with
+    ConnectionError once the bounded budget is exhausted — never a
+    silent hang."""
+    stub = _StubServer(kill_after=2)
+    try:
+        c = ServeClient("127.0.0.1", stub.port, window=2,
+                        read_timeout=2.0, retries=1, backoff_s=0.01)
+        c.stats()                       # arm
+        stub.stop()                     # no listener to come back to
+        time.sleep(0.6)                 # let the stub's loops wind down
+        with pytest.raises((ConnectionError, TimeoutError)):
+            c.submit_async({"prng_seed": 1}).wait()
+        c.close()
+    finally:
+        stub.stop()
+
+
+def test_async_surface_requires_window(base_cfg):
+    server = _server(base_cfg)
+    try:
+        c = ServeClient("127.0.0.1", server.port)      # window=0
+        with pytest.raises(ValueError, match="window"):
+            c.submit_async({"prng_seed": 0})
+        with pytest.raises(ValueError, match="window"):
+            c.result_async(0)
+        c.close()
+    finally:
+        server.stop()
+
+
+@pytest.mark.slow
+def test_pipelined_rejects_and_errors_still_typed(base_cfg):
+    """The parse/raise surface is identical through the pipe: a bad
+    scenario raises ServeReject from ``.wait()``, an unknown id raises
+    RuntimeError — the reply taxonomy survives multiplexing.  (Slow:
+    sibling coverage in the interleave test holds tier-1's budget per
+    the PR 5/11 rule.)"""
+    server = _server(base_cfg)
+    try:
+        c = ServeClient("127.0.0.1", server.port, window=4)
+        with pytest.raises(ServeReject, match="bad scenario"):
+            c.submit_async({"bogus": 1}).wait()
+        with pytest.raises(RuntimeError, match="unknown request id"):
+            c.result_async(777, timeout=5).wait()
+        rid = c.submit_async({"prng_seed": 0}).wait()
+        assert c.result_async(rid, timeout=120).wait()["converged"]
+        c.drain()
+        c.close()
+    finally:
+        server.stop()
