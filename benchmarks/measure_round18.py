@@ -1,0 +1,425 @@
+"""Round-18 measurements: the serving federation under whole-fleet
+loss and multi-tenant contention, plus the warm-program import path.
+
+Three measurement families, one JSON row each (resumable per-config
+like the round-7..17 drivers), all driven by the seed-deterministic
+multi-tenant shapes in benchmarks/loadgen.py so every A/B arm offers
+IDENTICAL load:
+
+* ``r18_warm_import`` — the cold-fleet acceptance, in process: a warm
+  service exports its parked compiled programs, a COLD service imports
+  the manifest, pays every trace at import, then serves that family
+  with zero compiles during serving (``admission_recompiles == 0`` AND
+  ``chunk_retraces == prewarm_traces`` — the program ledger, so
+  ``zero_recompile_ok`` is asserted, not inferred from timing).
+
+* ``r18_chaos_{nokill,kill}`` — the whole-fleet-loss A/B: a two-fleet
+  federation serves the same bursty multi-tenant stream twice; the
+  kill arm SIGKILLs every process of the busiest fleet mid-flight.
+  Both rows carry ``lost``/``dup``/``parity_ok``; the kill arm adds
+  ``detect_s`` (kill -> the health judge firing), ``mttr_s`` (detect
+  -> every affected request adopted from the salvage manifest or
+  re-admitted on the survivor), ``adopted``/``redirects``/
+  ``restarts``, and ``stale`` (epoch-fence refusals — must stay 0 in
+  a single-kill run).  Acceptance (ISSUE 16): sub-second detect,
+  lost = 0, dup = 0, parity_ok.
+
+* ``r18_fairness`` — the tenant-SLO A/B: the victim tenant's paced
+  stream runs SOLO (governor on, no contention) and then SHARED with
+  an aggressor offering 10x its own admission budget under equal
+  weights.
+  The governor sheds the aggressor's excess with the typed
+  ``SHED_OVER_BUDGET`` reason; the row carries both victim p50s and
+  ``within_10pct`` (ISSUE 16: the victim's shared p50 within 10% of
+  solo — fairness as an SLO, not a vibe).
+
+Run on the chip (watchdog chain step measure_round18):
+    PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/measure_round18.py
+Appends one JSON row per measurement to GOSSIP_R18_OUT (default
+benchmarks/results/round18_tpu.jsonl on TPU, round18_cpu.jsonl
+elsewhere).  Knobs: GOSSIP_R18_PEERS (16384), GOSSIP_R18_ROUNDS (64),
+GOSSIP_R18_CHAOS_N (12), GOSSIP_R18_CHAOS_RATE (8),
+GOSSIP_R18_FAIR_N (16), GOSSIP_R18_FAIR_RATE (2), GOSSIP_R18_SEED (0).
+"""
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+from benchmarks import loadgen
+
+
+def _out_path(cpu: bool) -> str:
+    default = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "results",
+        "round18_cpu.jsonl" if cpu else "round18_tpu.jsonl")
+    return os.environ.get("GOSSIP_R18_OUT", default)
+
+
+OUT = None          # set in main() once the platform is known
+
+
+def emit(row):
+    row["device"] = str(jax.devices()[0]).replace(" ", "_")
+    row["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    print(json.dumps(row), flush=True)
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def _landed() -> set:
+    from benchmarks._common import landed
+    return landed(OUT)
+
+
+def _cfg(n: int, rounds: int, extra: str = ""):
+    from p2p_gossipprotocol_tpu.config import NetworkConfig
+
+    cfg_text = (f"127.0.0.1:8000\nbackend=jax\nn_peers={n}\n"
+                f"n_messages=16\navg_degree=8\nrounds={rounds}\n"
+                "serve_chunk=2\nserve_replicas=1\n" + extra)
+    with tempfile.NamedTemporaryFile("w", suffix=".txt",
+                                     delete=False) as f:
+        f.write(cfg_text)
+        path = f.name
+    # NOTE: the file must OUTLIVE the config — federation fleet
+    # children and their replica grandchildren re-parse it at launch
+    return NetworkConfig(path), path
+
+
+def _row_parity(cfg, overrides, row) -> bool:
+    """Row-level parity probe vs a local solo run (metric-derived
+    fields; the full-leaf bitwise cross-product lives in
+    tests/test_serve.py — the federation adds hops, not an engine)."""
+    from p2p_gossipprotocol_tpu.fleet import build_scenarios
+
+    ov = {k: v for k, v in overrides.items()
+          if k not in ("deadline_ms", "priority", "tenant")}
+    solo = build_scenarios(cfg, [ov])[0].sim.run(row["rounds_run"])
+    return (float(solo.coverage[-1]) == row["final_coverage"]
+            and int(round(float(solo.deliveries.sum())))
+            == row["total_deliveries"])
+
+
+def _drive(svc, overrides, gaps, timeout=900):
+    """Paced submits against the federation facade; one waiter thread
+    per request (the federation's result() follows recovery).  Returns
+    ``(rids, rows, shed, wall)`` — ``shed[i]`` is the typed reason
+    when submit itself shed the request (tenant budget)."""
+    from p2p_gossipprotocol_tpu.serve import ServeShed
+
+    rids, rows, shed = {}, {}, {}
+    threads = []
+
+    def wait_one(rid, idx):
+        try:
+            rows[idx] = svc.result(rid, timeout=timeout)
+        except Exception:   # noqa: BLE001 — a lost request is the metric
+            rows[idx] = None
+
+    t0 = time.perf_counter()
+    for i, (ov, gap) in enumerate(zip(overrides, gaps)):
+        time.sleep(gap)
+        try:
+            rid = svc.submit(dict(ov))
+        except ServeShed as e:
+            shed[i] = str(e)
+            continue
+        rids[i] = rid
+        t = threading.Thread(target=wait_one, args=(rid, i),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=timeout)
+    wall = time.perf_counter() - t0
+    return rids, rows, shed, wall
+
+
+def _p50(rows, idxs):
+    lat = sorted(rows[i]["latency_ms"] for i in idxs
+                 if rows.get(i) and "latency_ms" in rows[i])
+    return round(lat[len(lat) // 2], 3) if lat else None
+
+
+def bench_warm_import(n: int, rounds: int, done):
+    tag = "r18_warm_import"
+    if tag in done:
+        return
+    from p2p_gossipprotocol_tpu.serve import GossipService
+
+    cfg, path = _cfg(n, rounds)
+    t0 = time.perf_counter()
+    svc1 = GossipService(cfg, slots=2, target=0.99,
+                         rounds=rounds).start()
+    try:
+        rid = svc1.submit({"prng_seed": 0})
+        svc1.result(rid, timeout=600)
+        deadline = time.monotonic() + 120
+        man = {"entries": []}
+        while time.monotonic() < deadline and not man.get("entries"):
+            man = svc1.park_export()
+            time.sleep(0.1)
+    finally:
+        svc1.drain(timeout=60)
+    svc2 = GossipService(cfg, slots=2, target=0.99, rounds=rounds)
+    t_imp = time.perf_counter()
+    res = svc2.park_import(man)
+    import_s = time.perf_counter() - t_imp
+    svc2.start()
+    try:
+        lines = [{"prng_seed": 3}, {"prng_seed": 4}]
+        rids = [svc2.submit(ov) for ov in lines]
+        rows = [svc2.result(r, timeout=600) for r in rids]
+        parity = all(_row_parity(cfg, ov, row)
+                     for ov, row in zip(lines, rows))
+    finally:
+        st = svc2.drain(timeout=60)
+        os.unlink(path)
+    emit({"config": tag, "n_peers": n, "rounds": rounds,
+          "entries": len(man.get("entries", [])),
+          "imported": res["imported"],
+          "prewarm_traces": res["prewarm_traces"],
+          "import_s": round(import_s, 4),
+          "served": len(rows),
+          "chunk_retraces": st["chunk_retraces"],
+          "admission_recompiles": st["admission_recompiles"],
+          "prewarmed": st["prewarmed"],
+          "zero_recompile_ok":
+              (st["admission_recompiles"] == 0
+               and st["chunk_retraces"] == res["prewarm_traces"]),
+          "parity_ok": parity,
+          "wall_s": round(time.perf_counter() - t0, 4)})
+
+
+def bench_chaos(kill: bool, n: int, rounds: int, n_req: int,
+                rate: float, seed: int, done):
+    tag = f"r18_chaos_{'kill' if kill else 'nokill'}"
+    if tag in done:
+        return
+    from p2p_gossipprotocol_tpu.serve import FederationService
+    from p2p_gossipprotocol_tpu.serve.directory import L_INFLIGHT
+
+    # identical bursty multi-tenant load on both arms (same seed)
+    overrides, gaps = loadgen.synth(
+        "bursty", rate, n_req, seed=seed,
+        tenants={"acme": 3.0, "blue": 1.0})
+    cfg, path = _cfg(n, rounds)
+    run_dir = tempfile.mkdtemp(prefix="gossip_r18_")
+    svc = FederationService(cfg, fleets=2, run_dir=run_dir)
+    t0 = time.perf_counter()
+    try:
+        svc.start()
+        svc.wait_ready(timeout=600)
+        t_ready = time.perf_counter()
+        detect_s = None
+        if not kill:
+            rids, rows, _shed, wall = _drive(svc, overrides, gaps)
+        else:
+            # drive in a thread so the axe lands mid-stream, on a
+            # plane with real in-flight depth (the bursty shape's
+            # point)
+            res = {}
+
+            def run():
+                res["out"] = _drive(svc, overrides, gaps)
+
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            deadline = time.monotonic() + 60
+            victim = None
+            while time.monotonic() < deadline:
+                with svc._lock:
+                    load = {}
+                    for r in svc._requests.values():
+                        if (r.status == L_INFLIGHT
+                                and r.fleet is not None):
+                            load[r.fleet] = load.get(r.fleet, 0) + 1
+                if sum(load.values()) >= max(2, n_req // 4):
+                    victim = max(load, key=load.get)
+                    break
+                time.sleep(0.05)
+            t_kill = time.time()
+            if victim is not None:
+                svc.kill_fleet(victim)
+            t.join(timeout=900)
+            rids, rows, _shed, wall = res["out"]
+            st_mid = svc.stats()
+            if victim is not None and "last_death_ts" in st_mid:
+                detect_s = round(st_mid["last_death_ts"] - t_kill, 4)
+        st = svc.drain(timeout=300)
+        got = [i for i in rids if rows.get(i) is not None]
+        dup = len(got) - len({rows[i]["request"] for i in got})
+        parity = all(_row_parity(cfg, overrides[i], rows[i])
+                     for i in got[:3] + got[-3:])
+        emit({"config": tag, "kill": kill, "n_peers": n,
+              "rounds": rounds, "n": n_req, "rate_rps": rate,
+              "seed": seed, "shape": "bursty", "fleets": 2,
+              "submitted": len(rids),
+              "lost": len(rids) - len(got), "dup": dup,
+              "parity_ok": parity,
+              "p50_ms": _p50(rows, got),
+              "deaths": st["deaths"], "restarts": st["restarts"],
+              "adopted": st["adopted"], "redirects": st["redirects"],
+              "stale": st["ledger"]["stale"],
+              "ledger_dup": st["ledger"]["dup"],
+              "detect_s": detect_s,
+              "mttr_s": st.get("mttr_s"),
+              "ready_s": round(t_ready - t0, 4),
+              "wall_s": round(wall, 4)})
+    finally:
+        svc.stop()
+        os.unlink(path)
+
+
+def bench_fairness(n: int, rounds: int, n_req: int, rate: float,
+                   seed: int, done):
+    tag = "r18_fairness"
+    if tag in done:
+        return
+    from p2p_gossipprotocol_tpu.serve import (SHED_OVER_BUDGET,
+                                              FederationService)
+
+    # governor: equal weights, capacity 4x the victim's offered rate —
+    # the victim never touches its half; the aggressor offers 10x ITS
+    # budget (10 * admit_rps/2) and sheds ~90% of it.  Window = 0.5 s
+    # so budget refresh happens many times per run.
+    admit_rps = 4 * rate
+    agg_rate = 10 * (admit_rps / 2)
+    extra = (f"federate_admit_rps={admit_rps:g}\n"
+             "federate_budget_s=0.5\n"
+             "federate_tenants=victim=1,aggressor=1\n")
+    cfg, path = _cfg(n, rounds, extra)
+    # the victim's stream: ONE signature family, evenly paced (the
+    # fairness row measures latency under contention, not arrival
+    # clumping), identical in both arms
+    victim = [{"prng_seed": 100 + i, "tenant": "victim"}
+              for i in range(n_req)]
+    v_gaps = [1.0 / rate] * n_req
+    warm = max(2, n_req // 4)             # skip the compile transient
+    run_dir = tempfile.mkdtemp(prefix="gossip_r18_")
+
+    def run_arm(with_aggressor: bool):
+        svc = FederationService(
+            cfg, fleets=1,
+            run_dir=tempfile.mkdtemp(prefix="gossip_r18_",
+                                     dir=run_dir))
+        try:
+            svc.start()
+            svc.wait_ready(timeout=600)
+            # prewarm the family so both arms measure steady-state
+            # scheduling, not the one-time compile transient (which
+            # would bury a 10% fairness bound under seconds of XLA)
+            svc.result(svc.submit({"prng_seed": 999,
+                                   "tenant": "victim"}), timeout=600)
+            agg_stop = threading.Event()
+            agg_shed = [0, None]          # count, first typed reason
+            if with_aggressor:
+                # the flood: same signature family (no new compiles —
+                # the contention is real serving work, not XLA), 10x
+                # the aggressor's own budget, fire-and-forget waits
+                from p2p_gossipprotocol_tpu.serve import ServeShed
+
+                def flood():
+                    import random as _r
+                    rng = _r.Random(seed ^ 0xA66)
+                    k = 0
+                    while not agg_stop.is_set():
+                        time.sleep(rng.expovariate(agg_rate))
+                        try:
+                            rid = svc.submit({"prng_seed": 500 + k,
+                                              "tenant": "aggressor"})
+                            threading.Thread(
+                                target=lambda r=rid: _swallow(
+                                    svc, r),
+                                daemon=True).start()
+                        except ServeShed as e:
+                            agg_shed[0] += 1
+                            if agg_shed[1] is None:
+                                agg_shed[1] = str(e)
+                        except Exception:  # noqa: BLE001
+                            pass
+                        k += 1
+
+                threading.Thread(target=flood, daemon=True).start()
+            rids, rows, shed, _wall = _drive(svc, victim, v_gaps)
+            agg_stop.set()
+            st = svc.drain(timeout=300)
+            return rids, rows, shed, agg_shed, st
+        finally:
+            svc.stop()
+
+    def _swallow(svc, rid):
+        try:
+            svc.result(rid, timeout=600)
+        except Exception:   # noqa: BLE001
+            pass
+
+    t0 = time.perf_counter()
+    try:
+        _rids_s, rows_s, shed_s, _a, _st_s = run_arm(False)
+        rids_x, rows_x, shed_x, agg, st_x = run_arm(True)
+    finally:
+        os.unlink(path)
+    idx = [i for i in range(warm, n_req)]
+    p50_solo = _p50(rows_s, idx)
+    p50_shared = _p50(rows_x, idx)
+    ratio = (round(p50_shared / p50_solo, 4)
+             if p50_solo and p50_shared else None)
+    by_tenant = st_x["tenants"]["shed_by_tenant"]
+    emit({"config": tag, "n_peers": n, "rounds": rounds,
+          "n": n_req, "rate_rps": rate, "seed": seed,
+          "admit_rps": admit_rps, "budget_s": 0.5,
+          "aggressor_rate_rps": agg_rate,
+          "aggressor_over_budget_x": 10,
+          "warm_skip": warm,
+          "victim_p50_solo_ms": p50_solo,
+          "victim_p50_shared_ms": p50_shared,
+          "shared_over_solo": ratio,
+          "within_10pct": (ratio is not None and ratio <= 1.10),
+          "victim_shed": len(shed_s) + len(shed_x),
+          "aggressor_shed": agg[0],
+          "aggressor_admitted":
+              st_x["tenants"]["admitted"] - len(rids_x),
+          "shed_reason_typed": (agg[1] is not None
+                                and SHED_OVER_BUDGET in agg[1]),
+          "shed_by_tenant": by_tenant,
+          "wall_s": round(time.perf_counter() - t0, 4)})
+
+
+def main():
+    global OUT
+    backend = jax.default_backend()
+    on_tpu = backend in ("tpu", "axon")
+    OUT = _out_path(cpu=not on_tpu)
+    n = int(os.environ.get("GOSSIP_R18_PEERS", str(1 << 14)))
+    rounds = int(os.environ.get("GOSSIP_R18_ROUNDS", "64"))
+    chaos_n = int(os.environ.get("GOSSIP_R18_CHAOS_N", "12"))
+    chaos_rate = float(os.environ.get("GOSSIP_R18_CHAOS_RATE", "8"))
+    fair_n = int(os.environ.get("GOSSIP_R18_FAIR_N", "16"))
+    fair_rate = float(os.environ.get("GOSSIP_R18_FAIR_RATE", "2"))
+    seed = int(os.environ.get("GOSSIP_R18_SEED", "0"))
+    done = _landed()
+    if "_backend" not in done:
+        emit({"config": "_backend", "backend": backend, "n_peers": n,
+              "rounds": rounds, "chaos_n": chaos_n,
+              "chaos_rate": chaos_rate, "fair_n": fair_n,
+              "fair_rate": fair_rate, "seed": seed})
+    bench_warm_import(n, rounds, done)
+    bench_chaos(False, n, rounds, chaos_n, chaos_rate, seed, done)
+    bench_chaos(True, n, rounds, chaos_n, chaos_rate, seed, done)
+    bench_fairness(n, rounds, fair_n, fair_rate, seed, done)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

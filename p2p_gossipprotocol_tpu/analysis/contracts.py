@@ -52,6 +52,14 @@ FINGERPRINT_EXEMPT = {
     "serve": "plane",
     "serve_*": "plane",
     "sweep_*": "plane",
+    # the round-18 federation keys ride the same reasoning as serve_*:
+    # they shape how the fleet-of-fleets tier routes, recovers, and
+    # budgets tenants — never a scenario's trajectory (recovered and
+    # re-routed results stay bitwise their solo runs by the PR 9
+    # contract), and none carries a -1-auto spelling, so they belong
+    # here and not in AUTO_STATICS
+    "federate": "plane",
+    "federate_*": "plane",
     # run-length / checkpoint mechanics: rounds is the runtime argument
     # (a checkpoint resumes into ANY remaining-rounds budget),
     # checkpoint_* is where/how-often state persists (PR 3)

@@ -112,6 +112,32 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--serve-rank", type=int, default=0, metavar="R",
                    help="serve-replica mode: this replica's rank in "
                         "the fleet (stamped into the heartbeat)")
+    p.add_argument("--federate", action="store_true",
+                   help="jax mode: run the GLOBAL serving federation "
+                        "(serve/federation.py): federate_fleets "
+                        "independent --serve-fleet children (each the "
+                        "full router + replicas) behind one "
+                        "client-facing wire on local_ip:local_port.  "
+                        "Requests route to the fleet already warm for "
+                        "their signature (park manifests gossip "
+                        "through the fleet directory); a whole fleet's "
+                        "SIGKILL adopts its salvaged rows and "
+                        "re-admits in-flight rids onto survivors "
+                        "(zero lost, zero duplicated); per-tenant "
+                        "budgets shed an overloading tenant's excess "
+                        "with a typed reason, never its neighbors' "
+                        "(docs/ROBUSTNESS.md \"The federation\")")
+    p.add_argument("--fleet-name", default="", metavar="NAME",
+                   help="serve-fleet mode (set by the federation): "
+                        "this fleet's directory name, stamped into "
+                        "its fleet-kind heartbeat and salvage "
+                        "manifest")
+    p.add_argument("--fleet-epoch", type=int, default=0, metavar="E",
+                   help="serve-fleet mode (set by the federation): "
+                        "this fleet's generation number — manifests "
+                        "stamp it, and the federation refuses to "
+                        "adopt rows from any epoch but the one it "
+                        "assigned (the stale-manifest fence)")
     p.add_argument("--mesh-devices", type=int, default=None, metavar="N",
                    help="jax mode: shard the peer axis over an N-device "
                         "mesh (ShardedSimulator / "
@@ -517,8 +543,17 @@ def _run_serve_fleet(cfg: NetworkConfig, args) -> int:
         print(f"Error: {e}", file=sys.stderr)
         service.stop()
         return 1
+    # federation member mode (round 18): stamp a fleet-kind heartbeat
+    # carrying the BOUND wire port + this fleet's name/epoch, so the
+    # federation discovers where the fleet listens and judges its
+    # liveness — the replica heartbeat contract lifted one level
+    on_bound = None
+    if args.serve_heartbeat:
+        on_bound = (lambda port: service.configure_heartbeat(
+            args.serve_heartbeat, port, fleet=args.fleet_name,
+            epoch=args.fleet_epoch))
     try:
-        server.start()
+        server.start(on_bound=on_bound)
     except OSError as e:
         print(f"Error: cannot bind {cfg.get_local_ip()}:"
               f"{cfg.get_local_port()} ({e})", file=sys.stderr)
@@ -538,6 +573,70 @@ def _run_serve_fleet(cfg: NetworkConfig, args) -> int:
         stats = service.drain(timeout=600)
         service.stop()
     print(json.dumps({"engine": "serve-fleet", **stats}))
+    return 0
+
+
+def _run_federate(cfg: NetworkConfig, args) -> int:
+    """Run the global serving federation (serve/federation.py):
+    ``federate_fleets`` supervised ``--serve-fleet`` children behind
+    the cross-fleet locality router, fronted by the SAME wire protocol
+    on local_ip:local_port.  SIGINT/SIGTERM drain the federation
+    gracefully (fleets own their per-fleet salvage)."""
+    from p2p_gossipprotocol_tpu.serve.federation import FederationService
+    from p2p_gossipprotocol_tpu.serve.server import ServeServer
+
+    log = None if args.quiet else (
+        lambda msg: print(msg, file=sys.stderr))
+    try:
+        service = FederationService(cfg, n_peers=args.n_peers,
+                                    run_dir=args.checkpoint_dir or None,
+                                    log=log)
+    except ValueError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    server = ServeServer(service, cfg.get_local_ip(),
+                         cfg.get_local_port(),
+                         wire_format=cfg.wire_format, log=log)
+
+    def handler(signum, frame):
+        print("\nReceived signal to terminate — draining the "
+              "federation (in-flight work finishes on the fleets "
+              "before exit).", file=sys.stderr)
+        server._stop.set()
+
+    signal.signal(signal.SIGINT, handler)
+    signal.signal(signal.SIGTERM, handler)
+    # form every fleet BEFORE opening the wire — the serve-fleet rule,
+    # one level up: a bound port must never front a forming federation
+    try:
+        service.start()
+        service.wait_ready(timeout=600)
+    except TimeoutError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        service.stop()
+        return 1
+    try:
+        server.start()
+    except OSError as e:
+        print(f"Error: cannot bind {cfg.get_local_ip()}:"
+              f"{cfg.get_local_port()} ({e})", file=sys.stderr)
+        service.stop()
+        return 1
+    if not args.quiet:
+        rebound = (f" (rebound from {server.rebound_from})"
+                   if server.rebound_from else "")
+        print(f"[jax/federate] federation on {cfg.get_local_ip()}:"
+              f"{server.port}{rebound} — {service.n_fleets} fleet(s) "
+              f"x {service.replicas_per_fleet} replica(s), health "
+              f"deadline {service.health_s:g}s, run dir "
+              f"{service.run_dir}")
+    try:
+        server.wait()
+    finally:
+        server.stop()
+        stats = service.drain(timeout=900)
+        service.stop()
+    print(json.dumps({"engine": "federate", **stats}))
     return 0
 
 
@@ -787,10 +886,15 @@ def main(argv: list[str] | None = None) -> int:
               file=sys.stderr)
         return 1
 
-    if args.serve_fleet or args.serve or cfg.serve:
-        # resident server (or the replicated fleet): the process stays
-        # up serving submissions; the one-shot path below never runs
-        what = "--serve-fleet" if args.serve_fleet else "--serve"
+    if args.federate or args.serve_fleet or args.serve or cfg.serve \
+            or getattr(cfg, "federate", 0):
+        # resident server (fleet, or the fleet-of-fleets federation):
+        # the process stays up serving; the one-shot path never runs
+        what = ("--serve-fleet" if args.serve_fleet
+                else "--serve" if args.serve
+                else "--federate" if args.federate
+                or getattr(cfg, "federate", 0)
+                else "--serve")
         if cfg.backend != "jax":
             print(f"Error: {what} is a jax-backend feature (the "
                   "socket runtime is one real peer process; the serve "
@@ -802,8 +906,17 @@ def main(argv: list[str] | None = None) -> int:
                   "engine batches push/pull/pushpull scenarios)",
                   file=sys.stderr)
             return 1
+        # explicit child-role flags FIRST: the federation spawns
+        # --serve-fleet children and the router spawns --serve children
+        # from the SAME config file — a `federate`/`serve` config key
+        # must never re-dispatch a child back into its parent's role
+        # (fork recursion)
         if args.serve_fleet:
             return _run_serve_fleet(cfg, args)
+        if args.serve:
+            return _run_serve(cfg, args)
+        if args.federate or getattr(cfg, "federate", 0):
+            return _run_federate(cfg, args)
         return _run_serve(cfg, args)
 
     if args.supervise or cfg.supervise:

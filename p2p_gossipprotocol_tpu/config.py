@@ -241,6 +241,17 @@ _SIM_INT_KEYS = {
     "serve_autoscale_min": "serve_autoscale_min",
     "serve_autoscale_max": "serve_autoscale_max",
     "serve_autoscale_hold": "serve_autoscale_hold",
+    # Serving federation (round 18; serve/federation.py; CLI
+    # --federate): federate=1 runs the cross-fleet tier — F
+    # independent --serve-fleet children (each the unmodified router +
+    # replicas) behind ONE client-facing wire, with warm-program
+    # locality routing over the fleet directory, whole-fleet-loss
+    # recovery through the epoch-fenced ownership ledger, and
+    # per-tenant weighted admission budgets (federate_admit_rps
+    # capacity split by federate_tenants weights per federate_budget_s
+    # window; 0 = fairness governor off).
+    "federate": "federate",
+    "federate_fleets": "federate_fleets",
     # Self-healing multi-process runs (runtime/supervisor.py; jax
     # backend, engine=aligned): supervise=1 launches the run as
     # supervise_workers worker processes under the health plane —
@@ -306,6 +317,13 @@ _SIM_FLOAT_KEYS = {
     # (runtime.supervisor.chunk_deadline_s).
     "supervise_grace_s": "supervise_grace_s",
     "supervise_deadline_s": "supervise_deadline_s",
+    # Serving federation (round 18; serve/federation.py): fleet-
+    # heartbeat staleness for whole-fleet-wedge detection, plus the
+    # tenant-fairness capacity (requests/s, 0 = governor off) and the
+    # window on which per-tenant budgets refresh.
+    "federate_health_s": "federate_health_s",
+    "federate_admit_rps": "federate_admit_rps",
+    "federate_budget_s": "federate_budget_s",
 }
 _SIM_STR_KEYS = {
     "local_ip": "local_ip",
@@ -335,6 +353,10 @@ _SIM_STR_KEYS = {
     # Serving plane: where served-scenario rows append (concurrency-
     # safe O_APPEND writes — fleet.driver.append_rows).
     "serve_results": "serve_results",
+    # Serving federation: per-tenant fairness weights as
+    # "name=weight,name=weight" (empty = every tenant weighs 1; the
+    # share of federate_admit_rps each tenant may spend per window).
+    "federate_tenants": "federate_tenants",
     # Supervision spmd mode: auto (try jax.distributed, fall back to
     # the single-process-spmd chief rehearsal where multi-process
     # collectives don't exist), or force either.
@@ -495,6 +517,14 @@ class NetworkConfig:
         self.serve_autoscale_min = 1     # narrowest slot width
         self.serve_autoscale_max = 64    # widest slot width
         self.serve_autoscale_hold = 3    # shrink/close hysteresis ticks
+        # Serving federation (round 18; serve/federation.py;
+        # --federate): fleet-of-fleets routing + recovery + fairness
+        self.federate = 0                # 1 = run the federation tier
+        self.federate_fleets = 2         # member --serve-fleet count
+        self.federate_health_s = 2.0     # fleet-heartbeat staleness
+        self.federate_admit_rps = 0.0    # tenant capacity; 0 = off
+        self.federate_budget_s = 1.0     # budget refresh window (s)
+        self.federate_tenants = ""       # "name=weight,..." shares
         # Telemetry plane (telemetry/; docs/OBSERVABILITY.md)
         self.telemetry = 0               # 1 = spans+counters+roofline on
         self.telemetry_ring = 4096       # flight-recorder ring bound
@@ -675,6 +705,42 @@ class NetworkConfig:
                 "serve_health_s must be > 0 — the router needs a "
                 "finite heartbeat-staleness deadline to detect a hung "
                 "replica")
+        if self.federate not in (0, 1):
+            raise ConfigError(
+                "federate must be 0 (single fleet / single server) or "
+                "1 (the cross-fleet federation tier)")
+        if self.federate_fleets < 1:
+            raise ConfigError(
+                "federate_fleets must be >= 1 (the federation needs "
+                "at least one member fleet to route to)")
+        if self.federate_health_s <= 0:
+            raise ConfigError(
+                "federate_health_s must be > 0 — the federation needs "
+                "a finite heartbeat-staleness deadline to detect a "
+                "hung fleet")
+        if self.federate_admit_rps < 0:
+            raise ConfigError(
+                "federate_admit_rps must be >= 0 (0 = fairness "
+                "governor off; > 0 = admission capacity split among "
+                "tenants by weight)")
+        if self.federate_budget_s <= 0:
+            raise ConfigError(
+                "federate_budget_s must be > 0 (the window on which "
+                "per-tenant admission budgets refresh)")
+        for part in str(self.federate_tenants or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, eq, w = part.partition("=")
+            try:
+                ok = bool(name.strip()) and bool(eq) and float(w) > 0
+            except ValueError:
+                ok = False
+            if not ok:
+                raise ConfigError(
+                    f"federate_tenants entry {part!r} must be "
+                    "name=weight with weight > 0 (e.g. "
+                    "\"alpha=3,beta=1\")")
         if self.supervise:
             if self.supervise_workers < 1 \
                     or self.supervise_devs_per_proc < 1:

@@ -88,6 +88,16 @@ HB_PHASES = ("launch", "init", "hold", "run", "done")
 #: scheduler's SLO machinery, not the supervisor's).
 SERVE_REPLICA_KIND = "serve-replica"
 
+#: heartbeat ``kind`` a whole serving FLEET stamps (round 18 — the
+#: federation tier, serve/federation.py): the fleet child is the
+#: supervision plane's third child kind — one ``--serve-fleet`` router
+#: process fronting its own replica children.  Same heartbeat-file
+#: contract, judged by the FEDERATION against ``federate_health_s``;
+#: the stamp additionally carries the fleet's name and EPOCH (its
+#: federation-assigned generation — the fence that makes a dead
+#: generation's salvage manifest unreadoptable).
+SERVE_FLEET_KIND = "serve-fleet"
+
 
 # ----------------------------------------------------------------------
 # Heartbeat protocol (worker side writes, supervisor side reads).
@@ -353,6 +363,58 @@ def spawn_serve_replica(argv: list[str], *, run_dir: str,
         argv, env=env, start_new_session=True,
         stdout=open(os.path.join(run_dir, f"replica_{rank}.out"), "ab"),
         stderr=open(os.path.join(run_dir, f"replica_{rank}.err"), "ab"))
+
+
+# ----------------------------------------------------------------------
+# Serve-fleet children (the federation tier: serve/federation.py).
+
+
+def serve_fleet_argv(config_path: str, *, port: int,
+                     heartbeat_path: str, run_dir: str, fleet: str,
+                     epoch: int, n_peers: int | None = None,
+                     extra_args: tuple[str, ...] = ()) -> list[str]:
+    """The command line for one serve-fleet child: the ordinary
+    ``--serve-fleet`` CLI (the PR 13/15 router + its replica children,
+    unmodified) entered on its own wire port with its own run dir and
+    a fleet-kind heartbeat file carrying its federation identity
+    (``--fleet-name``/``--fleet-epoch``) — the replica contract lifted
+    one level: the whole fleet is one supervised child of the
+    federation."""
+    cmd = [sys.executable, "-m", "p2p_gossipprotocol_tpu.cli",
+           config_path, "--serve-fleet", "--quiet",
+           "--local-ip", "127.0.0.1",
+           "--local-port", str(port),
+           "--serve-heartbeat", heartbeat_path,
+           "--fleet-name", fleet,
+           "--fleet-epoch", str(epoch),
+           "--checkpoint-dir", run_dir]
+    if n_peers:
+        cmd += ["--n-peers", str(n_peers)]
+    cmd += list(extra_args)
+    return cmd
+
+
+def spawn_serve_fleet(argv: list[str], *, run_dir: str,
+                      fleet: str) -> subprocess.Popen:
+    """Launch one fleet child the way :func:`spawn_serve_replica`
+    launches replicas: its own session (the federation's reap kills
+    the router's group; the router's replicas are their OWN sessions —
+    the federation reaps them by the pids their heartbeat files
+    carry), stdout/stderr into per-fleet files under ``run_dir``, and
+    the backend probe suppressed (the federation vetted the
+    environment once)."""
+    import p2p_gossipprotocol_tpu
+
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(p2p_gossipprotocol_tpu.__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["GOSSIP_NO_BACKEND_PROBE"] = "1"
+    os.makedirs(run_dir, exist_ok=True)
+    return subprocess.Popen(
+        argv, env=env, start_new_session=True,
+        stdout=open(os.path.join(run_dir, f"fleet_{fleet}.out"), "ab"),
+        stderr=open(os.path.join(run_dir, f"fleet_{fleet}.err"), "ab"))
 
 
 class Supervisor:

@@ -32,7 +32,15 @@ serving scale, borrowing LLM-serving continuous batching:
   clients unaffected) and a telemetry-driven control loop
   (:mod:`serve.autoscale`) consumes the occupancy/queue-depth gauges
   to grow/shrink bucket slot widths (live occupants migrated bitwise)
-  and open/close buckets, every decision a typed ``autoscale`` event.
+  and open/close buckets, every decision a typed ``autoscale`` event;
+* round 18 federated the plane globally: :mod:`serve.federation`
+  fronts F independent router fleets behind the same wire with
+  warm-program locality routing (parked compiled programs export /
+  import through :mod:`serve.directory`'s gossiped manifests — a cold
+  fleet warms from neighbors, not XLA), whole-fleet-loss recovery
+  through the epoch-fenced :class:`serve.directory.OwnershipLedger`
+  (zero lost, zero duplicated), and per-tenant weighted admission
+  budgets (typed ``SHED_OVER_BUDGET`` shedding).
 
 docs/ARCHITECTURE.md "The serving seam" has the admission rules and
 why the bitwise contract holds.
@@ -41,14 +49,24 @@ why the bitwise contract holds.
 from p2p_gossipprotocol_tpu.serve.autoscale import (Autoscaler,
                                                     AutoscaleDecision,
                                                     BucketObservation)
+from p2p_gossipprotocol_tpu.serve.directory import (FleetDirectory,
+                                                    OwnershipLedger,
+                                                    gossip_pairs)
+from p2p_gossipprotocol_tpu.serve.federation import (FederationService,
+                                                     TenantGovernor,
+                                                     parse_tenant_weights)
 from p2p_gossipprotocol_tpu.serve.scheduler import (SHED_AT_ADMISSION,
                                                     SHED_IN_QUEUE,
-                                                    SHED_ON_DRAIN, Request,
+                                                    SHED_ON_DRAIN,
+                                                    SHED_OVER_BUDGET,
+                                                    Request,
                                                     Scheduler, ServeReject,
                                                     ServeShed)
 from p2p_gossipprotocol_tpu.serve.service import GossipService, ServeBucket
 
 __all__ = ["Autoscaler", "AutoscaleDecision", "BucketObservation",
-           "GossipService", "Request", "Scheduler", "ServeBucket",
+           "FederationService", "FleetDirectory", "GossipService",
+           "OwnershipLedger", "Request", "Scheduler", "ServeBucket",
            "ServeReject", "ServeShed", "SHED_AT_ADMISSION",
-           "SHED_IN_QUEUE", "SHED_ON_DRAIN"]
+           "SHED_IN_QUEUE", "SHED_ON_DRAIN", "SHED_OVER_BUDGET",
+           "TenantGovernor", "gossip_pairs", "parse_tenant_weights"]

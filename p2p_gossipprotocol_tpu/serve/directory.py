@@ -1,0 +1,263 @@
+"""The fleet directory: membership, liveness, and warm-program gossip
+for the federation tier — plus the ownership ledger that makes
+whole-fleet recovery exactly-once.
+
+Two primitives, both deliberately the repo's own protocol eating its
+own dogfood (ROADMAP item 4):
+
+* :class:`FleetDirectory` — the membership/anti-entropy plane.  Each
+  fleet is one stamped file (atomic tmp+rename through
+  ``utils.logging.write_atomic`` — a reader must never see a torn
+  stamp; the supervisor heartbeat discipline, lifted to a directory of
+  whole fleets) carrying its epoch, wire port, and warm-park manifest.
+  Staleness keys on file mtime exactly like the heartbeat judge: same
+  machine, no clock-skew question.  Across hosts the same payloads
+  ride the existing serve wire (``park``/``stats`` documents); the
+  directory is the local rendezvous, not a new transport.
+  :func:`gossip_pairs` is the anti-entropy sampler: a PeerSwap-style
+  seed-deterministic pairing (arXiv:2408.03829 — randomized but
+  reproducible peer selection with uniform coverage), so which fleet
+  warms which neighbor in a tick is a pure function of (seed, tick)
+  and the chaos harness can replay any exchange schedule bit-for-bit.
+
+* :class:`OwnershipLedger` — per-request ownership as a join
+  semilattice (the state-based CRDT discipline): each request
+  id maps to ``(state, fleet, epoch, version)`` where terminal states
+  dominate INFLIGHT, the first terminal write wins (at-most-once — the
+  router's ``_finish`` dedup, lifted one level), and fleet epochs are
+  fenced monotonically: a salvage manifest stamped with an epoch older
+  than the ledger's current generation for that fleet is REFUSED
+  wholesale (``stale``), because a relaunched fleet numbers its rids
+  afresh — adopting the corpse's rows under the new generation's ids
+  would be the double-report the whole design exists to prevent.
+  Merging a manifest is therefore idempotent, commutative, and
+  monotone: replaying it, or racing two detectors over it, converges
+  to the same ledger.
+
+docs/ROBUSTNESS.md "The federation" has the failure taxonomy and the
+merge-semantics argument; tests/test_federation.py pins both
+primitives without processes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+
+#: ledger request states (the semilattice's chain: INFLIGHT is the
+#: bottom, the terminal pair is the top — once terminal, always
+#: terminal, and the first terminal row is the one clients see)
+L_INFLIGHT, L_DONE, L_FAILED = "inflight", "done", "failed"
+
+_TERMINAL = (L_DONE, L_FAILED)
+
+
+def gossip_pairs(names: list[str], *, seed: int,
+                 tick: int) -> list[tuple[str, str]]:
+    """One anti-entropy round's exchange schedule: a seed-deterministic
+    random perfect matching over ``names`` (PeerSwap-style — each tick
+    re-pairs, so over ticks every pair meets with uniform frequency,
+    but any single tick is replayable from (seed, tick) alone).  With
+    an odd count the last fleet sits the round out."""
+    order = sorted(names)
+    rng = random.Random((int(seed) * 1_000_003) ^ int(tick))
+    rng.shuffle(order)
+    return [(order[i], order[i + 1])
+            for i in range(0, len(order) - 1, 2)]
+
+
+class FleetDirectory:
+    """Atomic stamped files, one per fleet, under ``root`` — the
+    federation's membership view (see module docstring)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def path(self, name: str) -> str:
+        return os.path.join(self.root, f"fleet_{name}.json")
+
+    def stamp(self, name: str, payload: dict) -> None:
+        """Publish one fleet's directory entry (atomic — tmp+rename via
+        the blessed write helper; the mtime IS the liveness signal)."""
+        from p2p_gossipprotocol_tpu.utils.logging import write_atomic
+
+        doc = {"name": name, "ts": time.time(), **payload}
+        try:
+            write_atomic(self.path(name), json.dumps(doc,
+                                                     sort_keys=True))
+        except OSError:
+            pass               # a torn disk never kills the federation
+
+    def read(self, name: str) -> dict | None:
+        """One fleet's stamp plus its file ``mtime``, or None when
+        absent or torn mid-replace (the next read sees the committed
+        one) — the heartbeat-reader contract."""
+        try:
+            path = self.path(name)
+            with open(path) as fp:
+                doc = json.load(fp)
+            doc["mtime"] = os.path.getmtime(path)
+            return doc
+        except (OSError, ValueError):
+            return None
+
+    def fleets(self) -> dict[str, dict]:
+        """Every readable stamp, keyed by fleet name."""
+        out: dict[str, dict] = {}
+        try:
+            files = sorted(os.listdir(self.root))
+        except OSError:
+            return out
+        for fn in files:
+            if not (fn.startswith("fleet_") and fn.endswith(".json")):
+                continue
+            name = fn[len("fleet_"):-len(".json")]
+            doc = self.read(name)
+            if doc is not None:
+                out[name] = doc
+        return out
+
+    def alive(self, stale_s: float) -> dict[str, dict]:
+        """The stamps younger than ``stale_s`` — the membership set an
+        anti-entropy tick pairs over."""
+        now = time.time()
+        return {n: d for n, d in self.fleets().items()
+                if now - d["mtime"] <= stale_s}
+
+    def forget(self, name: str) -> None:
+        """Drop a fleet's stamp (its corpse must not advertise warm
+        programs to the locality router)."""
+        try:
+            os.unlink(self.path(name))
+        except OSError:
+            pass
+
+
+class OwnershipLedger:
+    """The federation's per-request ownership lattice (see module
+    docstring).  Thread-safe: claims arrive from client submit
+    threads, terminal rows from result waiters AND the recovery path,
+    and merges from whichever detector finds the corpse first — every
+    mutation and every read of the mutable maps happens under the one
+    lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: rid -> {"state", "fleet", "epoch", "version", "row"}
+        self._entries: dict[int, dict] = {}
+        #: fleet name -> current generation (monotone; the fence)
+        self._epochs: dict[str, int] = {}
+        self.n_dup = 0
+        self.n_stale = 0
+
+    # -- epoch fence ----------------------------------------------------
+    def advance_epoch(self, fleet: str, epoch: int) -> None:
+        """Record ``fleet``'s current generation (monotone max — an
+        out-of-order advance cannot roll the fence back)."""
+        with self._lock:
+            if epoch > self._epochs.get(fleet, -1):
+                self._epochs[fleet] = int(epoch)
+
+    def epoch_of(self, fleet: str) -> int:
+        with self._lock:
+            return self._epochs.get(fleet, -1)
+
+    # -- writes (all monotone) ------------------------------------------
+    def claim(self, rid: int, fleet: str, epoch: int) -> None:
+        """Record (or move — a redirect bumps the version) ownership of
+        an in-flight request.  A terminal entry is never reopened."""
+        with self._lock:
+            e = self._entries.get(rid)
+            if e is None:
+                self._entries[rid] = {"state": L_INFLIGHT,
+                                      "fleet": fleet,
+                                      "epoch": int(epoch),
+                                      "version": 0, "row": None}
+                return
+            if e["state"] in _TERMINAL:
+                return
+            e["fleet"] = fleet
+            e["epoch"] = int(epoch)
+            e["version"] += 1
+
+    def complete(self, rid: int, row: dict | None, *,
+                 failed: bool = False) -> bool:
+        """Join a terminal row in from the LIVE path (a result wait on
+        the owning fleet).  First terminal write wins; a duplicate is
+        counted and dropped.  Returns True when this write is the one
+        clients will see."""
+        with self._lock:
+            e = self._entries.get(rid)
+            if e is None:
+                e = {"state": L_INFLIGHT, "fleet": "", "epoch": 0,
+                     "version": 0, "row": None}
+                self._entries[rid] = e
+            if e["state"] in _TERMINAL:
+                self.n_dup += 1
+                return False
+            e["state"] = L_FAILED if failed else L_DONE
+            e["row"] = row
+            return True
+
+    def merge(self, done_rows: dict, *, fleet: str,
+              epoch: int) -> tuple[int, int, int]:
+        """The lattice join over a salvage manifest: adopt every
+        completed row for a rid this ledger still holds INFLIGHT on
+        ``fleet``.  Returns ``(adopted, dup, stale)``:
+
+        * ``stale`` — the whole manifest is from an epoch older than
+          the ledger's fence for ``fleet``: refused, nothing read (a
+          relaunched generation numbers rids afresh — the corpse's
+          rows under fresh ids would double-report);
+        * ``dup`` — rows whose rid is already terminal (the other
+          detector, or the live path, won — idempotence);
+        * ``adopted`` — rows joined in as DONE.
+
+        Replaying the same manifest (or racing two detectors over it)
+        converges: adopted+dup is stable, the surviving row per rid is
+        the first one written."""
+        with self._lock:
+            if int(epoch) < self._epochs.get(fleet, -1):
+                self.n_stale += 1
+                return (0, 0, 1)
+            adopted = dup = 0
+            for rid_s, row in done_rows.items():
+                rid = int(rid_s)
+                e = self._entries.get(rid)
+                if e is None or e["fleet"] != fleet \
+                        or e["state"] in _TERMINAL:
+                    if e is not None and e["state"] in _TERMINAL:
+                        dup += 1
+                        self.n_dup += 1
+                    continue
+                e["state"] = L_DONE
+                e["row"] = row
+                adopted += 1
+            return (adopted, dup, 0)
+
+    # -- reads ----------------------------------------------------------
+    def get(self, rid: int) -> dict | None:
+        with self._lock:
+            e = self._entries.get(rid)
+            return dict(e) if e is not None else None
+
+    def inflight_on(self, fleet: str) -> list[int]:
+        """The rids a dying fleet still owns — recovery's re-admission
+        worklist."""
+        with self._lock:
+            return sorted(rid for rid, e in self._entries.items()
+                          if e["fleet"] == fleet
+                          and e["state"] == L_INFLIGHT)
+
+    def counts(self) -> dict:
+        with self._lock:
+            states = [e["state"] for e in self._entries.values()]
+            return {"entries": len(states),
+                    "inflight": states.count(L_INFLIGHT),
+                    "done": states.count(L_DONE),
+                    "failed": states.count(L_FAILED),
+                    "dup": self.n_dup, "stale": self.n_stale}

@@ -135,6 +135,18 @@ class ReplicaHandle:
         with self.rpc_lock:
             return self.client.stats()
 
+    def park(self) -> dict:
+        if self.pipelined:
+            return self.client.park()
+        with self.rpc_lock:
+            return self.client.park()
+
+    def warm(self, manifest: dict) -> dict:
+        if self.pipelined:
+            return self.client.warm(manifest)
+        with self.rpc_lock:
+            return self.client.warm(manifest)
+
     def drain(self) -> dict:
         if self.pipelined:
             return self.client.drain()
@@ -212,6 +224,19 @@ class RouterService:
         self._last_death_ts: float | None = None
         self._stop = threading.Event()
         self._health_thread: threading.Thread | None = None
+        # round 18 (federation member mode): the router itself becomes
+        # a supervised child — it stamps a fleet-kind heartbeat and
+        # refreshes a fleet-level salvage manifest (done rows keyed by
+        # ROUTER rid — the id space the federation dispatched into),
+        # the replica discipline lifted one level.  Armed by
+        # configure_heartbeat; the epoch is the federation's fence
+        # against adopting a dead generation's stale manifest.
+        self.fleet_name = ""
+        self.fleet_epoch = 0
+        self.heartbeat_path: str | None = None
+        self.heartbeat_port = 0
+        self._last_hb = 0.0
+        self._last_fleet_persist = 0.0
 
     # -- lifecycle ------------------------------------------------------
     def _spawn(self, rank: int, generation: int = 0) -> ReplicaHandle:
@@ -264,6 +289,62 @@ class RouterService:
                     f"{timeout:g}s (see {self.run_dir}/replica_*.err)")
             time.sleep(0.05)
 
+    def configure_heartbeat(self, path: str, port: int, *,
+                            fleet: str = "", epoch: int = 0) -> None:
+        """Arm the fleet-kind heartbeat + manifest (round 18, call
+        before start()): the health loop stamps ``path`` sub-second
+        with the router's bound wire ``port`` and its federation
+        identity (fleet name + epoch), and refreshes the fleet salvage
+        manifest every ``persist_every_s`` — what the federation
+        adopts completed rows from after a whole-fleet SIGKILL."""
+        self.heartbeat_path = path
+        self.heartbeat_port = int(port)
+        self.fleet_name = str(fleet)
+        self.fleet_epoch = int(epoch)
+
+    def _stamp_heartbeat(self) -> None:
+        from p2p_gossipprotocol_tpu.runtime.supervisor import (
+            SERVE_FLEET_KIND, write_heartbeat)
+
+        try:
+            write_heartbeat(
+                self.heartbeat_path, rank=0, phase="run",
+                extra={"kind": SERVE_FLEET_KIND,
+                       "port": self.heartbeat_port,
+                       "fleet": self.fleet_name,
+                       "epoch": self.fleet_epoch})
+        except OSError:
+            pass                   # a torn disk never kills routing
+
+    def fleet_manifest_path(self) -> str:
+        return os.path.join(self.run_dir, "fleet_manifest.json")
+
+    def _persist_fleet_manifest(self) -> None:
+        """The fleet-level salvage artifact: completed rows keyed by
+        ROUTER rid (the federation's dispatch id space — replica
+        manifests key by replica-local rids the federation cannot
+        map), plus the in-flight rid list, stamped with this fleet's
+        epoch so a relaunched generation's federation refuses the
+        corpse's manifest (atomic write — the reader must never see a
+        torn one)."""
+        from p2p_gossipprotocol_tpu.utils.checkpoint import _write_atomic
+
+        with self._lock:
+            done = {str(r.rid): r.row
+                    for r in self._requests.values()
+                    if r.status == R_DONE and r.row is not None}
+            inflight = [r.rid for r in self._requests.values()
+                        if r.status == INFLIGHT]
+        manifest = {"schema": 1, "kind": "serve-fleet",
+                    "fleet": self.fleet_name,
+                    "epoch": self.fleet_epoch,
+                    "done": done, "inflight": inflight}
+        try:
+            _write_atomic(self.fleet_manifest_path(),
+                          json.dumps(manifest, sort_keys=True))
+        except OSError:
+            pass
+
     # -- signature routing ---------------------------------------------
     def _signature_of(self, overrides: dict) -> tuple:
         """The request's compiled-program identity (``fleet/packer
@@ -274,7 +355,7 @@ class RouterService:
         layer pads it, so off-grid peer counts share their family's
         entry.  Raises :class:`ServeReject` on an unresolvable
         scenario — the named rejection stays at the door."""
-        ov, _deadline, _priority = Scheduler.split_slo(overrides)
+        ov, _deadline, _priority, _tenant = Scheduler.split_slo(overrides)
         sketch = dict(ov)
         sketch.pop("prng_seed", None)
         if self.pad_peers and "n_peers" in sketch:
@@ -517,6 +598,7 @@ class RouterService:
             out["shed"] = shed
         lat = []
         per = {}
+        park: dict[str, list[int]] = {}
         for h in handles:
             if not h.alive:
                 continue
@@ -527,17 +609,80 @@ class RouterService:
                                     "generation": h.generation, **st}
                 if "p50_ms" in st:
                     lat.append((st.get("p50_ms"), st.get("p99_ms")))
+                # round 18: the fleet's warm-park inventory — the
+                # union of every live replica's signature → widths map
+                # (what the federation's locality router reads)
+                for s, ws in (st.get("park") or {}).items():
+                    got = set(park.get(s, ()))
+                    got.update(int(w) for w in ws)
+                    park[s] = sorted(got)
             except (ConnectionError, OSError, RuntimeError):
                 continue
         out["replica_stats"] = per
+        out["park"] = park
         if lat:
             out["p50_ms"] = max(p for p, _ in lat)
             out["p99_ms"] = max(q for _, q in lat)
         return out
 
+    # -- warm-program export/import (round 18) --------------------------
+    def park_export(self) -> dict:
+        """The FLEET's warm-program manifest: every live replica's
+        export, deduplicated by signature (first replica wins — entries
+        for the same family are interchangeable: same overrides, and
+        the widths ride per-entry)."""
+        entries, seen = [], set()
+        with self._lock:
+            handles = [h for h in self._replicas if h.alive]
+        for h in handles:
+            try:
+                m = h.park()
+            except (ConnectionError, OSError, RuntimeError):
+                continue
+            for e in m.get("entries", []):
+                s = e.get("signature")
+                if s in seen:
+                    continue
+                seen.add(s)
+                entries.append(e)
+        return {"schema": 1, "entries": entries}
+
+    def park_import(self, manifest: dict) -> dict:
+        """Warm this fleet from a neighbor's manifest: each entry is
+        routed to its signature's AFFINITY replica (the one its
+        requests will stick to — warming any other replica would be
+        compilation nobody admits against) and imported there."""
+        entries = manifest.get("entries")
+        if not isinstance(entries, list):
+            raise ServeReject("warm manifest needs an 'entries' list")
+        out = {"imported": 0, "skipped": 0, "prewarm_traces": 0}
+        for e in entries:
+            if not isinstance(e, dict):
+                out["skipped"] += 1
+                continue
+            sig = self._signature_of(dict(e.get("overrides") or {}))
+            h = self._route(sig)
+            try:
+                r = h.warm({"schema": 1, "entries": [e]})
+            except (ConnectionError, OSError) as err:
+                self._mark_dead(h, f"warm transport error: "
+                                   f"{type(err).__name__}: {err}")
+                out["skipped"] += 1
+                continue
+            for k in ("imported", "skipped", "prewarm_traces"):
+                out[k] += int(r.get(k, 0))
+        return out
+
     # -- health + recovery ----------------------------------------------
     def _health_loop(self) -> None:
         while not self._stop.is_set():
+            now = time.monotonic()
+            if self.heartbeat_path and now - self._last_hb >= 0.2:
+                self._last_hb = now
+                self._stamp_heartbeat()
+            if now - self._last_fleet_persist >= self.persist_every_s:
+                self._last_fleet_persist = now
+                self._persist_fleet_manifest()
             with self._lock:
                 handles = list(self._replicas)
             for h in handles:

@@ -67,11 +67,19 @@ class ServeShed(ServeReject):
 SHED_AT_ADMISSION = "shed:doomed-at-admission"
 SHED_IN_QUEUE = "shed:doomed-in-queue"
 SHED_ON_DRAIN = "shed:drain-during-overload"
+#: round 18 (the federation's fairness plane): a request whose TENANT
+#: exhausted its weighted admission budget for the current refresh
+#: interval — the aggressor's excess is shed at the federation door so
+#: it never displaces a neighbor's in-budget work
+SHED_OVER_BUDGET = "shed:over-tenant-budget"
 
 #: request-dict keys that shape SCHEDULING, never the simulated
 #: trajectory — stripped before the scenario resolves (they are not
-#: config keys, so leaving them in would be an unknown-key rejection)
-SLO_KEYS = ("deadline_ms", "priority")
+#: config keys, so leaving them in would be an unknown-key rejection).
+#: ``tenant`` (round 18) names the paying party for the federation's
+#: per-tenant budget accounting; like the SLO fields it rides the
+#: request dict, never the trajectory.
+SLO_KEYS = ("deadline_ms", "priority", "tenant")
 
 
 #: request lifecycle states, in order
@@ -91,6 +99,9 @@ class Request:
     #: from the scenario dict, so they never reach the trajectory
     deadline_ms: float | None = None
     priority: int = 0
+    #: the paying party (round 18) — budget accounting only, never the
+    #: trajectory; "" = the anonymous default tenant
+    tenant: str = ""
     #: perf_counter stamps of the four accounting instants
     t_enqueue: float = 0.0
     t_admit: float | None = None
@@ -191,14 +202,17 @@ class Scheduler:
 
     # -- client side ----------------------------------------------------
     @staticmethod
-    def split_slo(overrides: dict) -> tuple[dict, float | None, int]:
-        """``(scenario_overrides, deadline_ms, priority)`` with the SLO
-        fields stripped — the one parse both the scheduler and the
-        fleet router use, so the two doors validate identically.
-        Raises :class:`ServeReject` on a non-numeric field."""
+    def split_slo(overrides: dict
+                  ) -> tuple[dict, float | None, int, str]:
+        """``(scenario_overrides, deadline_ms, priority, tenant)`` with
+        the SLO fields stripped — the one parse every door (scheduler,
+        fleet router, federation) uses, so they all validate
+        identically.  Raises :class:`ServeReject` on a non-numeric
+        deadline/priority or a non-string tenant."""
         ov = dict(overrides)
         deadline_ms = ov.pop("deadline_ms", None)
         priority = ov.pop("priority", 0)
+        tenant = ov.pop("tenant", "")
         if deadline_ms is not None:
             try:
                 deadline_ms = float(deadline_ms)
@@ -212,7 +226,11 @@ class Scheduler:
             raise ServeReject(
                 f"bad scenario: priority must be an integer, got "
                 f"{priority!r}")
-        return ov, deadline_ms, priority
+        if not isinstance(tenant, str):
+            raise ServeReject(
+                f"bad scenario: tenant must be a string, got "
+                f"{tenant!r}")
+        return ov, deadline_ms, priority, tenant
 
     def submit(self, overrides: dict, rid: int | None = None) -> Request:
         """Resolve + enqueue one request; raises :class:`ServeReject`
@@ -221,7 +239,8 @@ class Scheduler:
         by resume re-hydration, which must keep the original ids."""
         from p2p_gossipprotocol_tpu import telemetry
 
-        overrides, deadline_ms, priority = self.split_slo(overrides)
+        overrides, deadline_ms, priority, tenant = \
+            self.split_slo(overrides)
         if deadline_ms is None and self.deadline_default_ms > 0:
             deadline_ms = self.deadline_default_ms
         if deadline_ms is not None and deadline_ms <= 0 \
@@ -270,7 +289,7 @@ class Scheduler:
         req = Request(rid=rid, overrides=dict(overrides), spec=spec,
                       signature=bucket_signature(spec.sim),
                       deadline_ms=deadline_ms, priority=priority,
-                      t_enqueue=time.perf_counter())
+                      tenant=tenant, t_enqueue=time.perf_counter())
         with self._lock:
             # re-check the bound under the lock (resolution dropped it)
             if len(self.queue) >= self.queue_max:

@@ -313,6 +313,44 @@ class ServeServer:
                                              f"{e}"}, ctx, seq)
                 return True
             self._reply(conn, {"type": "profile", **res}, ctx, seq)
+        elif op == "park":
+            # warm-program export (round 18): the signature-keyed
+            # manifest of every compiled chunk program this service
+            # holds — what the federation gossips through the fleet
+            # directory so a cold fleet warms from neighbors
+            try:
+                manifest = self.service.park_export()
+            except AttributeError:
+                self._reply(conn, {"type": "error",
+                                   "reason": "this server has no "
+                                             "park export"}, ctx, seq)
+                return True
+            self._reply(conn, {"type": "park", "manifest": manifest},
+                        ctx, seq)
+        elif op == "warm":
+            # warm-program import: pre-trace the manifest's programs
+            # OFF the admission path (parked buckets — admission later
+            # reopens them with zero retraces)
+            manifest = doc.get("manifest")
+            if not isinstance(manifest, dict):
+                self._reply(conn, {"type": "rejected",
+                                   "reason": "warm needs a "
+                                             "'manifest' object"},
+                            ctx, seq)
+                return True
+            try:
+                res = self.service.park_import(manifest)
+            except ServeReject as e:
+                self._reply(conn, {"type": "rejected",
+                                   "reason": e.reason}, ctx, seq)
+                return True
+            except Exception as e:  # noqa: BLE001 — import failed, say so
+                self._reply(conn, {"type": "error",
+                                   "reason": f"warm import failed: "
+                                             f"{type(e).__name__}: "
+                                             f"{e}"}, ctx, seq)
+                return True
+            self._reply(conn, {"type": "warmed", **res}, ctx, seq)
         elif op == "drain":
             stats = self.service.drain()
             self._reply(conn, {"type": "drained", **stats}, ctx, seq)
@@ -706,6 +744,29 @@ class ServeClient:
 
     def stats(self) -> dict:
         return self._rpc({"type": "stats"})
+
+    def park(self) -> dict:
+        """The server's warm-program export manifest (round 18):
+        ``{"schema": 1, "entries": [{overrides, widths, chunk,
+        signature}, ...]}`` — one entry per compiled signature family,
+        resident or parked."""
+        resp = self._rpc({"type": "park"})
+        if resp.get("type") != "park":
+            raise RuntimeError(resp.get("reason", str(resp)))
+        return resp["manifest"]
+
+    def warm(self, manifest: dict, timeout: float = 300.0) -> dict:
+        """Import a warm-program manifest: the server pre-traces the
+        advertised (signature, width) programs off its admission path
+        and parks them.  Returns ``{"imported": n, "skipped": m}``.
+        Raises :class:`ServeReject` via the rejected reply."""
+        resp = self._rpc({"type": "warm", "manifest": manifest},
+                         wait_s=timeout)
+        if resp.get("type") == "rejected":
+            raise ServeReject(resp.get("reason", "rejected"))
+        if resp.get("type") != "warmed":
+            raise RuntimeError(resp.get("reason", str(resp)))
+        return {k: v for k, v in resp.items() if k != "type"}
 
     def metrics(self) -> str:
         """The counter/gauge text page (the scrape surface)."""

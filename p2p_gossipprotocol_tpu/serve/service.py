@@ -413,6 +413,18 @@ class GossipService:
         #: for long-gone families are dropped, oldest first.
         self._parked: dict = {}
         self._parked_cap = max(16, 2 * self.max_buckets)
+        #: warm-import inbox (round 18, the federation's warm-program
+        #: gossip): manifests arrive on handler threads, but buckets
+        #: belong to the serving loop — entries queue here and the loop
+        #: pre-traces them at its next boundary (compilation moved OFF
+        #: the admission path, counted in ``prewarmed``)
+        self._warm_lock = threading.Lock()
+        self._warm_inbox: list = []
+        self.prewarmed = 0
+        #: loop-published export manifest twin of the occupancy
+        #: snapshot (same atomic-swap discipline): what ``park_export``
+        #: serves without touching buckets the loop may be mutating
+        self._park_manifest: dict = {"schema": 1, "entries": []}
         #: trace ledger of buckets that left entirely (discarded on
         #: eviction with autoscale off, or LRU-dropped from the lot):
         #: the recompile metrics are CUMULATIVE — compile work must
@@ -597,7 +609,18 @@ class GossipService:
             "slot_width_min": min(widths) if widths else 0,
             "slot_width_max": max(widths) if widths else 0,
             "slot_width_peak": self.slot_width_peak,
+            # round 18: the warm-park inventory — every signature
+            # family with a compiled chunk program (resident or
+            # parked) and the widths it is warm at.  The federation's
+            # locality router reads this through /stats.
+            "park": {repr(b.signature): sorted(b._fleets)
+                     for b in every},
+            "prewarmed": self.prewarmed,
         }
+        self._park_manifest = {"schema": 1, "entries": [
+            {"overrides": dict(b.template_spec.overrides),
+             "widths": sorted(b._fleets), "chunk": b.chunk,
+             "signature": repr(b.signature)} for b in every]}
         # /metrics gauges mirror the snapshot (no-ops when telemetry
         # is off)
         telemetry.gauge_set("serve_buckets", self._occupancy["buckets"])
@@ -618,6 +641,141 @@ class GossipService:
         out = self.scheduler.stats()
         out.update(self._occupancy)
         return out
+
+    # -- warm-program export/import (round 18: federation gossip) -------
+    def park_export(self) -> dict:
+        """The warm-program manifest: one entry per signature family
+        this service holds a compiled chunk program for — its template
+        overrides (the family, re-resolvable anywhere the base config
+        matches), the widths it is warm at, and its signature repr
+        (the import-side identity check).  Served from the
+        loop-published snapshot — safe from any thread, at most one
+        chunk stale, same discipline as the occupancy snapshot."""
+        return self._park_manifest
+
+    def park_import(self, manifest: dict, timeout: float = 300.0
+                    ) -> dict:
+        """Warm this service from a neighbor's export manifest: every
+        entry whose signature is not already warm here gets a parked
+        bucket with its chunk programs PRE-TRACED at the advertised
+        widths — compilation paid now, off the admission path, so the
+        first request of an imported family admits with zero retraces
+        (the cold-fleet acceptance).  Buckets belong to the serving
+        loop, so entries queue through the warm inbox and the loop
+        imports at its next boundary; this call blocks until then.
+        Returns ``{"imported": n, "skipped": m}`` (already-warm and
+        signature-mismatched entries skip)."""
+        entries = manifest.get("entries")
+        if not isinstance(entries, list):
+            raise ServeReject("warm manifest needs an 'entries' list")
+        box = {"imported": 0, "skipped": 0, "prewarm_traces": 0,
+               "error": None}
+        done = threading.Event()
+        if not self.is_running():
+            # no loop owns the buckets yet (pre-start warm) — import
+            # inline on the caller's thread
+            self._do_import(entries, box)
+        else:
+            with self._warm_lock:
+                self._warm_inbox.append((entries, box, done))
+            self._wake.set()
+            deadline = time.monotonic() + timeout
+            while not done.wait(0.1):
+                if not self.is_running():
+                    raise ServeReject(
+                        "warm import dropped: the serving loop "
+                        "stopped before the inbox drained")
+                if time.monotonic() > deadline:
+                    raise ServeReject(
+                        f"warm import did not complete within "
+                        f"{timeout:g}s")
+        if box["error"] is not None:
+            raise ServeReject(f"warm import failed: {box['error']}")
+        return {"imported": box["imported"], "skipped": box["skipped"],
+                "prewarm_traces": box["prewarm_traces"]}
+
+    def _prewarm_bucket(self, b: ServeBucket, widths: list[int]) -> int:
+        """Trace ``b``'s chunk program at each width, on the all-idle
+        batch (computes-and-discards under the convergence mask — the
+        park contract's safety argument, so the next admission scatters
+        over it exactly as over init_idle).  The device_get is the sync
+        point that makes the compile actually land here, not at first
+        admission."""
+        n = 0
+        for w in sorted(widths):
+            if (w, self.chunk) in b._programs:
+                continue
+            if b.slots != w:
+                b.resize(w)            # idle: pure init_idle, no payload
+            _ys, dhist = b.dispatch()
+            jax.device_get(dhist)
+            n += 1
+        return n
+
+    def _do_import(self, entries: list, box: dict) -> None:
+        """Run on whichever thread owns the buckets (the serving loop,
+        or the caller before start): resolve, verify, pre-trace, park.
+        Never raises — the outcome rides ``box`` back to the waiter."""
+        from p2p_gossipprotocol_tpu.fleet.packer import bucket_signature
+
+        try:
+            warm = {b.signature for b in self.buckets} \
+                | set(self._parked)
+            for e in entries:
+                if not isinstance(e, dict):
+                    box["skipped"] += 1
+                    continue
+                ov = dict(e.get("overrides") or {})
+                widths = sorted({int(w) for w in
+                                 (e.get("widths") or [])}) \
+                    or [self.slots]
+                spec = resolve_request(
+                    self.cfg, ov, rid=-1, n_peers=self.n_peers,
+                    pad_peers=bool(self.cfg.sweep_pad_peers))
+                sig = bucket_signature(spec.sim)
+                want = e.get("signature")
+                if sig in warm or (want is not None
+                                   and want != repr(sig)):
+                    # already warm here, or the donor's base config
+                    # resolves this family to a different program —
+                    # importing would warm the WRONG signature
+                    box["skipped"] += 1
+                    continue
+                b = ServeBucket(spec, widths[0], self.chunk,
+                                self.target)
+                traces = self._prewarm_bucket(b, widths)
+                b.park()
+                self._lot_insert(b)
+                warm.add(sig)
+                self.prewarmed += traces
+                box["imported"] += 1
+                box["prewarm_traces"] += traces
+                telemetry.counter_add("serve_prewarm_total", traces)
+            telemetry.event("park_import", imported=box["imported"],
+                            skipped=box["skipped"],
+                            prewarm_traces=box["prewarm_traces"])
+            if self.log and box["imported"]:
+                self.log(f"[serve] warm-imported {box['imported']} "
+                         f"famil(ies) ({box['prewarm_traces']} "
+                         f"prewarm trace(s)), {box['skipped']} "
+                         "skipped")
+        except ServeReject as e:
+            box["error"] = e.reason
+        except Exception as e:  # noqa: BLE001 — surface to the waiter
+            box["error"] = f"{type(e).__name__}: {e}"
+
+    def _drain_warm_inbox(self) -> None:
+        """Loop-side: import every queued manifest at this boundary and
+        release the waiters."""
+        while True:
+            with self._warm_lock:
+                if not self._warm_inbox:
+                    return
+                entries, box, done = self._warm_inbox.pop(0)
+            try:
+                self._do_import(entries, box)
+            finally:
+                done.set()
 
     def profile_capture(self, duration_s: float = 2.0,
                         top_n: int = 20,
@@ -696,6 +854,17 @@ class GossipService:
         self._retired["expected"] += b.expected_traces()
         self._retired["admissions"] += b.admission_recompiles
 
+    def _lot_insert(self, b: ServeBucket) -> None:
+        """Put an idle bucket into the parking lot at the fresh end of
+        the LRU order, trimming past the cap (a dropped bucket's
+        compile ledger survives via ``_retire_ledger``)."""
+        self._parked.pop(b.signature, None)   # refresh LRU position
+        self._parked[b.signature] = b
+        while len(self._parked) > self._parked_cap:
+            oldest = next(iter(self._parked))
+            self._retire_ledger(self._parked[oldest])
+            del self._parked[oldest]
+
     def _park(self, b: ServeBucket) -> None:
         """Autoscale mode: retire an idle bucket into the parking lot
         (compiled programs kept, batch arrays released); without the
@@ -706,12 +875,7 @@ class GossipService:
             self._retire_ledger(b)
             return
         b.park()
-        self._parked.pop(b.signature, None)   # refresh LRU position
-        self._parked[b.signature] = b
-        while len(self._parked) > self._parked_cap:
-            oldest = next(iter(self._parked))
-            self._retire_ledger(self._parked[oldest])
-            del self._parked[oldest]
+        self._lot_insert(b)
 
     def _bucket_for(self, req: Request) -> ServeBucket | None:
         """Routing: same-signature bucket with a free slot, else a new
@@ -835,6 +999,8 @@ class GossipService:
             row["deadline_met"] = not req.past_deadline()
         if req.priority:
             row["priority"] = req.priority
+        if req.tenant:
+            row["tenant"] = req.tenant
         if r_i:
             row["final_coverage"] = float(res.coverage[-1])
             row["total_deliveries"] = int(round(
@@ -867,6 +1033,10 @@ class GossipService:
                     self._persist_all()
                     self.salvaged = True
                     return
+                # warm-program imports land at the boundary, BEFORE
+                # admission: a request racing its own family's import
+                # sees the parked warm bucket, not a cold miss
+                self._drain_warm_inbox()
                 self._admit_pending()
                 now = time.perf_counter()
                 if self.autoscale \
@@ -956,6 +1126,8 @@ class GossipService:
                 item["deadline_ms"] = r.deadline_ms
             if r.priority:
                 item["priority"] = r.priority
+            if r.tenant:
+                item["tenant"] = r.tenant
             return item
 
         os.makedirs(self.checkpoint_dir, exist_ok=True)
@@ -1126,6 +1298,8 @@ class GossipService:
                 ov["deadline_ms"] = item["deadline_ms"]
             if item.get("priority"):
                 ov["priority"] = item["priority"]
+            if item.get("tenant"):
+                ov["tenant"] = item["tenant"]
             self.scheduler.submit(ov, rid=int(item["rid"]))
         if self.log:
             self.log(f"[serve] resumed {len(self.buckets)} bucket(s), "

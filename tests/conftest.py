@@ -108,13 +108,15 @@ def _socket_suite_timeout(request):
     mod = getattr(request.module, "__name__", "")
     guarded = "socket" in mod or "preemption" in mod \
         or "supervisor" in mod or "serve" in mod \
-        or "telemetry" in mod or "tuning" in mod
+        or "telemetry" in mod or "tuning" in mod \
+        or "federation" in mod
     if not guarded or not hasattr(signal, "SIGALRM"):
         yield
         return
     budget = (SUPERVISOR_TEST_TIMEOUT_S
               if "supervisor" in mod or "serve" in mod
               or "telemetry" in mod or "tuning" in mod
+              or "federation" in mod
               else SOCKET_TEST_TIMEOUT_S)
 
     def _fire(signum, frame):

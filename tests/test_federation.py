@@ -1,0 +1,499 @@
+"""The serving federation (serve/federation.py + serve/directory.py):
+cross-fleet locality routing, whole-fleet-loss recovery through the
+epoch-fenced ownership ledger, and multi-tenant SLO fairness.
+
+Module name contains "federation", so conftest's SIGALRM guard covers
+these (420 s budget — the live tests drive fleet-of-fleets subprocess
+trees).
+
+The load-bearing contracts:
+
+* routing policy is a PURE function (``FederationService.pick_fleet``):
+  sticky signature affinity, warm-program locality from the directory's
+  park inventories, deterministic least-loaded fallback;
+* the ownership ledger is a join semilattice: first terminal write
+  wins (at-most-once federation-wide), merges are idempotent, and the
+  epoch fence refuses a dead generation's salvage manifest wholesale;
+* warm-program export/import really moves compiled programs: a cold
+  service that imports a neighbor's manifest serves that family with
+  ZERO compiles during serving (every trace landed at import —
+  ledger-asserted, the cold-fleet acceptance);
+* whole-fleet SIGKILL under load loses nothing and duplicates
+  nothing, and every recovered result equals its solo run;
+* per-tenant budgets shed with the typed ``SHED_OVER_BUDGET`` reason
+  at the federation door, before any fleet sees the work.
+"""
+
+import time
+
+import pytest
+
+from p2p_gossipprotocol_tpu.config import ConfigError, NetworkConfig
+from p2p_gossipprotocol_tpu.fleet import build_scenarios
+from p2p_gossipprotocol_tpu.serve import (SHED_OVER_BUDGET, GossipService,
+                                          ServeReject, ServeShed)
+from p2p_gossipprotocol_tpu.serve.directory import (L_INFLIGHT,
+                                                    FleetDirectory,
+                                                    OwnershipLedger,
+                                                    gossip_pairs)
+from p2p_gossipprotocol_tpu.serve.federation import (FederationService,
+                                                     TenantGovernor,
+                                                     parse_tenant_weights)
+
+BASE_CFG = """\
+127.0.0.1:8000
+backend=jax
+n_peers=1024
+n_messages=16
+avg_degree=8
+rounds=32
+serve_chunk=2
+serve_replicas=1
+"""
+
+
+@pytest.fixture()
+def fed_cfg(tmp_path):
+    # the config FILE must outlive the fixture: fleet children and
+    # their replica grandchildren re-parse it at launch
+    p = tmp_path / "fed.txt"
+    p.write_text(BASE_CFG)
+    return NetworkConfig(str(p))
+
+
+def _solo_row_equal(cfg, overrides, row) -> bool:
+    """Row-level parity probe across TWO process boundaries: the
+    federation adds hops, not an execution engine (the full-leaf
+    bitwise compare lives in tests/test_serve.py).  SLO fields —
+    tenant included — never reach the simulator."""
+    ov = {k: v for k, v in overrides.items()
+          if k not in ("deadline_ms", "priority", "tenant")}
+    solo = build_scenarios(cfg, [ov])[0].sim.run(row["rounds_run"])
+    return (float(solo.coverage[-1]) == row["final_coverage"]
+            and int(round(float(solo.deliveries.sum())))
+            == row["total_deliveries"])
+
+
+# ---------------------------------------------------------------------
+# no-process policy tests (cheap, tier-1)
+
+def test_gossip_pairs_deterministic_replayable():
+    """The anti-entropy sampler is a pure function of (seed, tick):
+    same inputs -> same exchange schedule regardless of name order;
+    different ticks re-pair; an odd fleet count sits one out."""
+    names = ["0", "1", "2", "3"]
+    a = gossip_pairs(names, seed=7, tick=3)
+    assert a == gossip_pairs(list(reversed(names)), seed=7, tick=3)
+    assert len(a) == 2
+    assert {n for p in a for n in p} == set(names)
+    # over many ticks every distinct pair meets (uniform coverage)
+    seen = set()
+    for t in range(64):
+        for x, y in gossip_pairs(names, seed=7, tick=t):
+            seen.add(frozenset((x, y)))
+    assert len(seen) == 6                 # C(4,2)
+    odd = gossip_pairs(["a", "b", "c"], seed=1, tick=0)
+    assert len(odd) == 1
+
+
+def test_directory_stamp_read_alive_forget(tmp_path):
+    """Stamped files are the membership plane: atomic publish, mtime
+    as the liveness signal, forget drops the corpse's advertisement."""
+    d = FleetDirectory(str(tmp_path / "dir"))
+    d.stamp("0", {"epoch": 2, "port": 1234, "park": {"sig": [2]}})
+    doc = d.read("0")
+    assert doc["name"] == "0" and doc["epoch"] == 2
+    assert doc["port"] == 1234 and "mtime" in doc
+    assert set(d.fleets()) == {"0"}
+    assert set(d.alive(stale_s=60)) == {"0"}
+    # a stamp aged past the staleness deadline is not a member
+    time.sleep(0.05)
+    assert d.alive(stale_s=0.01) == {}
+    d.forget("0")
+    assert d.read("0") is None and d.fleets() == {}
+    d.forget("0")                         # idempotent
+
+
+def test_ownership_ledger_first_terminal_write_wins():
+    """The at-most-once core: DONE is absorbing — the live path and
+    the adoption path can both try to land a row, only the first
+    wins, the loser is counted as a dup, never surfaced."""
+    led = OwnershipLedger()
+    led.claim(1, "0", 0)
+    assert led.complete(1, {"v": "live"}) is True
+    assert led.complete(1, {"v": "replay"}) is False
+    assert led.get(1)["row"] == {"v": "live"}
+    assert led.counts()["dup"] == 1
+    # a terminal entry is never reopened by a late claim
+    led.claim(1, "1", 0)
+    assert led.get(1)["fleet"] == "0"
+    # a redirect of a LIVE entry moves ownership and bumps version
+    led.claim(2, "0", 0)
+    led.claim(2, "1", 0)
+    e = led.get(2)
+    assert e["fleet"] == "1" and e["version"] == 1
+    assert e["state"] == L_INFLIGHT
+    assert led.inflight_on("1") == [2]
+
+
+def test_ownership_ledger_merge_is_an_idempotent_join():
+    """Adopting a salvage manifest converges: replaying the same
+    manifest (or racing two detectors over it) adds nothing, and rows
+    for rids another fleet owns are ignored."""
+    led = OwnershipLedger()
+    led.advance_epoch("0", 0)
+    led.claim(1, "0", 0)
+    led.claim(2, "0", 0)
+    led.claim(3, "1", 0)                  # other fleet's request
+    manifest = {"1": {"v": 1}, "2": {"v": 2}, "3": {"v": 3}}
+    assert led.merge(manifest, fleet="0", epoch=0) == (2, 0, 0)
+    # replay: both rids already terminal -> pure dup, zero adopted
+    adopted, dup, stale = led.merge(manifest, fleet="0", epoch=0)
+    assert adopted == 0 and dup == 2 and stale == 0
+    # rid 3 never moved: fleet "1" still owns it, inflight
+    assert led.get(3)["state"] == L_INFLIGHT
+    c = led.counts()
+    assert c["done"] == 2 and c["inflight"] == 1
+
+
+def test_ownership_ledger_epoch_fence_refuses_stale_manifest():
+    """The whole-fleet-recovery fence: once a fleet relaunches as
+    epoch N+1, the dead generation's manifest (epoch N) is refused
+    WHOLESALE — a relaunched generation numbers rids afresh, so the
+    corpse's rows under fresh ids would be the double-report."""
+    led = OwnershipLedger()
+    led.advance_epoch("0", 0)
+    led.claim(1, "0", 0)
+    led.advance_epoch("0", 1)             # the relaunch
+    adopted, dup, stale = led.merge({"1": {"v": "stale"}},
+                                    fleet="0", epoch=0)
+    assert (adopted, dup, stale) == (0, 0, 1)
+    assert led.get(1)["state"] == L_INFLIGHT
+    assert led.counts()["stale"] == 1
+    # the fence is monotone: an out-of-order advance cannot roll back
+    led.advance_epoch("0", 0)
+    assert led.epoch_of("0") == 1
+    # a current-epoch manifest still adopts
+    led.claim(1, "0", 1)
+    assert led.merge({"1": {"v": "ok"}}, fleet="0",
+                     epoch=1) == (1, 0, 0)
+
+
+def test_pick_fleet_locality_is_sticky_warm_then_least_loaded():
+    """The routing rule as a pure function: sticky owner first; else
+    the fleet advertising the signature WARM in the directory; else
+    least-loaded with lowest name breaking ties; no live fleets is a
+    named rejection."""
+    pick = FederationService.pick_fleet
+    live = ["0", "1"]
+    # sticky: an alive owner keeps its signature
+    assert pick("sX", live=live, affinity={"sX": "1"},
+                park_view={}, load={"0": 0, "1": 5}) == "1"
+    # a dead owner's signature re-routes (owner not in live)
+    assert pick("sX", live=["0"], affinity={"sX": "1"},
+                park_view={}, load={"0": 3}) == "0"
+    # warm locality beats load: fleet 1 already holds the program
+    assert pick("sY", live=live, affinity={},
+                park_view={"1": {"sY"}}, load={"0": 0, "1": 9}) == "1"
+    # cold everywhere: least-loaded, lowest name breaks ties
+    assert pick("sZ", live=live, affinity={},
+                park_view={}, load={"0": 2, "1": 2}) == "0"
+    assert pick("sZ", live=live, affinity={},
+                park_view={}, load={"0": 2, "1": 1}) == "1"
+    with pytest.raises(ServeReject, match="no live fleets"):
+        pick("sW", live=[], affinity={}, park_view={}, load={})
+
+
+def test_tenant_weights_parse_and_validate():
+    assert parse_tenant_weights("") == {}
+    assert parse_tenant_weights("a=3, b=1") == {"a": 3.0, "b": 1.0}
+    for bad in ("a", "a=", "=2", "a=0", "a=-1", "a=x"):
+        with pytest.raises(ValueError):
+            parse_tenant_weights(bad)
+
+
+def test_tenant_governor_weighted_shares_and_typed_shed():
+    """Fairness policy without processes (injectable clock): weighted
+    window quotas, typed over-budget sheds, refresh on the window
+    boundary, unknown tenants at weight 1, governor-off no-op."""
+    g = TenantGovernor(weights={"big": 3, "small": 1},
+                       admit_rps=8, budget_s=1.0)
+    # W = 4 -> big gets 6/window, small gets 2/window
+    assert g.quota("big") == 6.0 and g.quota("small") == 2.0
+    for _ in range(6):
+        g.admit("big", now=100.0)
+    with pytest.raises(ServeShed) as ei:
+        g.admit("big", now=100.5)
+    assert str(ei.value).startswith(SHED_OVER_BUDGET)
+    # the victim's share is untouched by the burst
+    g.admit("small", now=100.6)
+    g.admit("small", now=100.7)
+    with pytest.raises(ServeShed):
+        g.admit("small", now=100.8)
+    # window refresh restores everyone
+    g.admit("big", now=101.1)
+    g.admit("small", now=101.2)
+    c = g.counts()
+    assert c["admitted"] == 10 and c["shed"] == 2
+    assert c["shed_by_tenant"] == {"big": 1, "small": 1}
+    # an unconfigured tenant joins at weight 1 (W grows to 5)
+    assert g.quota("newcomer") == 8 * 1.0 / 5
+    # governor off: unlimited
+    off = TenantGovernor(admit_rps=0)
+    for _ in range(100):
+        off.admit("anyone", now=0.0)
+
+
+def test_tenant_is_an_slo_field_stripped_at_the_door():
+    """``tenant`` rides the SLO envelope exactly like deadline_ms /
+    priority: split off before resolution (the simulator never sees
+    it), type-checked with a named rejection."""
+    from p2p_gossipprotocol_tpu.serve.scheduler import Scheduler
+
+    ov, deadline, priority, tenant = Scheduler.split_slo(
+        {"prng_seed": 3, "deadline_ms": 500, "priority": 2,
+         "tenant": "acme"})
+    assert ov == {"prng_seed": 3}
+    assert deadline == 500 and priority == 2 and tenant == "acme"
+    assert Scheduler.split_slo({"x": 1})[3] == ""
+    with pytest.raises(ServeReject, match="tenant must be a string"):
+        Scheduler.split_slo({"tenant": 7})
+
+
+def test_federation_sheds_over_budget_at_the_door(fed_cfg):
+    """The governor sits BEFORE routing: an over-budget tenant sheds
+    with the typed reason even while no fleet exists — no fleet ever
+    sees the work (and the shed is not a lost request: it never
+    entered the ledger)."""
+    # quota = admit_rps * budget_s = 2 per window, with a window far
+    # longer than the test so a slow machine cannot refresh it
+    fed_cfg.federate_admit_rps = 0.05
+    fed_cfg.federate_budget_s = 40.0
+    fed_cfg.federate_tenants = "acme=1"
+    svc = FederationService(fed_cfg, fleets=1)   # never started
+    ov = {"prng_seed": 0, "tenant": "acme"}
+    # two submits pass the governor and then fail routing (no live
+    # fleets — a DIFFERENT, non-shed rejection)
+    for _ in range(2):
+        with pytest.raises(ServeReject, match="no live fleets"):
+            svc.submit(dict(ov))
+    with pytest.raises(ServeShed) as ei:
+        svc.submit(dict(ov))
+    assert str(ei.value).startswith(SHED_OVER_BUDGET)
+    assert svc.governor.counts()["shed_by_tenant"] == {"acme": 1}
+    assert svc.ledger.counts()["entries"] == 0
+
+
+def test_config_federate_surface(tmp_path):
+    """The federate_* keys parse from the config file and validate
+    with named errors (the config-drift rule holds them to network.txt
+    + consumption; this pins the parse/validate half)."""
+    p = tmp_path / "net.txt"
+    p.write_text(BASE_CFG + "federate=1\nfederate_fleets=3\n"
+                 "federate_health_s=0.5\nfederate_admit_rps=10\n"
+                 "federate_budget_s=2\nfederate_tenants=a=3,b=1\n")
+    cfg = NetworkConfig(str(p))
+    assert cfg.federate == 1 and cfg.federate_fleets == 3
+    assert cfg.federate_health_s == 0.5
+    assert cfg.federate_admit_rps == 10
+    assert cfg.federate_budget_s == 2
+    assert parse_tenant_weights(cfg.federate_tenants) == {"a": 3.0,
+                                                          "b": 1.0}
+    for bad in ("federate=2\n", "federate_fleets=0\n",
+                "federate_health_s=0\n", "federate_admit_rps=-1\n",
+                "federate_budget_s=0\n", "federate_tenants=a=0\n"):
+        q = tmp_path / "bad.txt"
+        q.write_text(BASE_CFG + bad)
+        with pytest.raises(ConfigError):
+            NetworkConfig(str(q))
+
+
+def test_federation_is_in_the_lint_scope():
+    """New files must not dodge the analysis seam: federation.py and
+    directory.py are parsed into gossip-lint's package scope, and both
+    are clean for the lock-discipline (the ownership ledger's lock
+    contract) and write-discipline (the directory's atomic stamps)
+    rules."""
+    from p2p_gossipprotocol_tpu.analysis.core import load_tree, run_rules
+
+    tree = load_tree()
+    rels = [s.rel for s in tree.package_sources()]
+    new = ["p2p_gossipprotocol_tpu/serve/federation.py",
+           "p2p_gossipprotocol_tpu/serve/directory.py"]
+    for rel in new:
+        assert rel in rels
+    findings = run_rules(tree, rule_ids={"lock-discipline",
+                                         "write-discipline"})
+    hits = [f for f in findings if f.file in new]
+    assert not hits, [f.render() for f in hits]
+
+
+# ---------------------------------------------------------------------
+# in-process warm-program export/import (the cold-fleet acceptance)
+
+def test_park_export_import_serves_with_zero_compiles(fed_cfg):
+    """The warm-program gossip contract end to end, in process: a warm
+    service exports its parked compiled programs; a COLD service
+    imports the manifest (pre-start inline path), pays every trace AT
+    IMPORT, then serves that family with zero compiles during serving
+    — chunk_retraces stays exactly the prewarm count and
+    admission_recompiles stays 0 (ledger-asserted), and results stay
+    solo-bitwise across the import."""
+    svc1 = GossipService(fed_cfg, slots=2, target=0.99,
+                         rounds=32).start()
+    try:
+        rid = svc1.submit({"prng_seed": 0})
+        row = svc1.result(rid, timeout=300)
+        assert row["converged"]
+        # the export appears at the next loop publish
+        deadline = time.monotonic() + 60
+        man = {"entries": []}
+        while time.monotonic() < deadline:
+            man = svc1.park_export()
+            if man.get("entries"):
+                break
+            time.sleep(0.1)
+        assert man["entries"], "warm-park export never appeared"
+        e = man["entries"][0]
+        assert e["signature"] and e["widths"] == [2]
+        assert e["chunk"] == 2
+    finally:
+        svc1.drain()
+    svc2 = GossipService(fed_cfg, slots=2, target=0.99, rounds=32)
+    res = svc2.park_import(man)
+    assert res["imported"] == 1 and res["prewarm_traces"] >= 1
+    # importing again is a no-op: the family is already warm
+    res2 = svc2.park_import(man)
+    assert res2["imported"] == 0 and res2["skipped"] == 1
+    assert res2["prewarm_traces"] == 0
+    svc2.start()
+    try:
+        lines = [{"prng_seed": 3}, {"prng_seed": 4}]
+        rids = [svc2.submit(ov) for ov in lines]
+        rows = [svc2.result(r, timeout=300) for r in rids]
+        assert all(r["converged"] for r in rows)
+        for row, ov in zip(rows, lines):
+            assert _solo_row_equal(fed_cfg, ov, row), (ov, row)
+    finally:
+        st = svc2.drain()
+    # the cold-fleet acceptance, ledger-asserted: every compile
+    # happened at import time, serving added ZERO
+    assert st["prewarmed"] == res["prewarm_traces"]
+    assert st["chunk_retraces"] == res["prewarm_traces"], st
+    assert st["admission_recompiles"] == 0, st
+    assert e["signature"] in st["park"]
+
+
+# ---------------------------------------------------------------------
+# live-federation tests (fleet-of-fleets subprocess trees)
+
+@pytest.mark.slow
+def test_federation_locality_and_anti_entropy(fed_cfg, tmp_path):
+    """Live smoke: two fleets (one replica each) behind the federation
+    facade — sticky locality routing (one fleet per signature family),
+    every result exactly once and solo-equal, the directory's
+    anti-entropy warming BOTH fleets for BOTH families, and the
+    zero-recompile ledger holding on every replica afterwards.
+    Slow-marked (two-level subprocess tree + compiles); tier-1 keeps
+    the no-process policy tests and the in-process import test."""
+    svc = FederationService(fed_cfg, fleets=2,
+                            run_dir=str(tmp_path / "fed"),
+                            directory_s=0.5)
+    try:
+        svc.start()
+        svc.wait_ready(timeout=360)
+        lines = [{"prng_seed": 0, "tenant": "acme"}, {"prng_seed": 1},
+                 {"prng_seed": 2, "mode": "pull"}]
+        rids = [svc.submit(ov) for ov in lines]
+        rows = [svc.result(r, timeout=300) for r in rids]
+        assert sorted(r["request"] for r in rows) == sorted(rids)
+        assert all(r["converged"] for r in rows)
+        # sticky locality: one fleet per family
+        assert rows[0]["fleet"] == rows[1]["fleet"]
+        assert rows[2]["fleet"] != rows[0]["fleet"]
+        # the tenant tag survives both hops onto the row
+        assert rows[0]["tenant"] == "acme"
+        for row, ov in zip(rows, lines):
+            assert _solo_row_equal(fed_cfg, ov, row), (ov, row)
+        # anti-entropy: both fleets end up warm for both families
+        deadline = time.monotonic() + 180
+        st = {}
+        while time.monotonic() < deadline:
+            st = svc.stats()
+            pv = st.get("park_view", {})
+            if (len(pv) == 2
+                    and all(len(sigs) >= 2 for sigs in pv.values())):
+                break
+            time.sleep(0.5)
+        pv = st.get("park_view", {})
+        assert len(pv) == 2 and all(len(s) >= 2 for s in pv.values()), pv
+        assert st["warm_exchanges"] >= 1
+        # the exchange moved programs, not recompiles: every replica
+        # of every fleet still satisfies the resize-aware ledger
+        for fname, fst in st["fleet_stats"].items():
+            for rk, rst in fst.get("replica_stats", {}).items():
+                assert rst["admission_recompiles"] == 0, (fname, rk)
+                assert rst["chunk_retraces"] == \
+                    rst["expected_retraces"], (fname, rk, rst)
+        st = svc.drain(timeout=300)
+        assert st["done"] == 3 and st["failed"] == 0
+        assert st["deaths"] == 0
+        assert st["ledger"]["dup"] == 0
+    finally:
+        svc.stop()
+
+
+@pytest.mark.slow
+def test_federation_whole_fleet_sigkill_exactly_once(fed_cfg,
+                                                     tmp_path):
+    """The whole-fleet-loss acceptance, in-suite: two fleets under
+    offered load, SIGKILL of every process of the busiest fleet at
+    once -> fast detection, recorded MTTR, and every accepted request
+    completing EXACTLY once (adopted from the fleet salvage manifest
+    or re-admitted onto the survivor) with results equal to solo runs
+    — zero lost, zero duplicated, zero stale-epoch adoptions."""
+    svc = FederationService(fed_cfg, fleets=2,
+                            run_dir=str(tmp_path / "chaos"))
+    try:
+        svc.start()
+        svc.wait_ready(timeout=360)
+        lines = []
+        for s in range(6):
+            ov = {"prng_seed": s}
+            if s % 2:
+                ov["mode"] = "pull"
+            lines.append(ov)
+        rids = [svc.submit(ov) for ov in lines]
+        time.sleep(0.5)                   # let chunks start landing
+        with svc._lock:
+            load = {}
+            for r in svc._requests.values():
+                if r.status == L_INFLIGHT and r.fleet is not None:
+                    load[r.fleet] = load.get(r.fleet, 0) + 1
+            victim = max(load, key=load.get) if load else "0"
+        t_kill = time.time()
+        svc.kill_fleet(victim)
+        rows = [svc.result(r, timeout=300) for r in rids]
+        st = svc.drain(timeout=300)
+        # zero lost: every accepted request completed
+        assert st["done"] == len(rids) and st["failed"] == 0
+        # zero duplicated: each federation rid exactly once, and the
+        # ledger never saw a double terminal write or a stale adopt
+        assert sorted(r["request"] for r in rows) == sorted(rids)
+        assert st["ledger"]["dup"] == 0
+        # detection + MTTR recorded (the fleet child is a direct
+        # child: process exit lands within ~one 50 ms poll)
+        assert st["deaths"] >= 1
+        assert st.get("mttr_s") is not None
+        detect_s = st["last_death_ts"] - t_kill
+        assert 0 <= detect_s < 2.0, detect_s
+        # recovery really ran: salvage adoption + re-admission cover
+        # the victim's in-flight load
+        assert st["redirects"] + st["adopted"] > 0
+        # the slot relaunched as a fresh epoch behind the fence
+        assert st["restarts"] >= 1
+        # every row — recovered or not — equals its solo run
+        for row, ov in zip(rows, lines):
+            assert _solo_row_equal(fed_cfg, ov, row), (ov, row)
+    finally:
+        svc.stop()

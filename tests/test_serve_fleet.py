@@ -172,6 +172,23 @@ def test_fleet_routes_and_never_recompiles_across_the_hop(fleet_cfg,
         assert rows[2]["replica"] != rows[0]["replica"]
         for row, ov in zip(rows, lines):
             assert _solo_row_equal(fleet_cfg, ov, row), (ov, row)
+        # round 18: the warm-park inventory rides stats() — signature
+        # -> parked widths, the union over live replicas (what the
+        # federation's locality router and directory read).  Retired
+        # buckets park at a loop boundary, so poll briefly.
+        want = {repr(svc._signature_of({"prng_seed": 0})),
+                repr(svc._signature_of({"prng_seed": 2,
+                                        "mode": "pull"}))}
+        deadline = time.monotonic() + 60
+        park = {}
+        while time.monotonic() < deadline:
+            park = svc.stats().get("park") or {}
+            if want <= set(park):
+                break
+            time.sleep(0.25)
+        assert want <= set(park), (want, sorted(park))
+        assert all(ws and all(int(w) >= 1 for w in ws)
+                   for ws in park.values()), park
         st = svc.drain(timeout=180)
         assert st["done"] == 3 and st["failed"] == 0
         assert st["deaths"] == 0 and st["redirects"] == 0
