@@ -134,6 +134,7 @@ STEPS="bench:1800 mosaic_smoke:2400 measure_round4:4800 \
   measure_round11:3600 round10_retry:3600 measure_round12:3600 \
   measure_round13:3600 measure_round14:3600 measure_round15:3600 \
   measure_round16:3600 measure_round17:3600 measure_round18:3600 \
+  measure_round19:3600 \
   baselines:4800 \
   multihost:1800 longrun:1800"
 STEP_NAMES=$(for s in $STEPS; do echo -n "${s%%:*} "; done)

@@ -36,6 +36,13 @@ FINGERPRINT_EXEMPT = {
     "overlap_mode": "bitwise-knob",
     "sir_fuse": "bitwise-knob",
     "hier_*": "bitwise-knob",
+    # realgraph (PR 19): pack width and gather/scatter pick HOW the
+    # same masked boolean OR executes — bitwise-identical either way
+    # (tests/test_realgraph.py pins realgraph == edges across both);
+    # graph_file/realgraph_format ARE fingerprinted (which graph was
+    # ingested is the trajectory)
+    "realgraph_pack_width": "bitwise-knob",
+    "realgraph_scatter": "bitwise-knob",
     # planes that watch or place a run, never steer it (supervise_*
     # PR 6, telemetry_* PR 10, serve_*/sweep_* PR 4/9 — the serving
     # and sweep surfaces wrap scenarios whose own keys ARE
@@ -207,7 +214,8 @@ TELEMETRY_BANNED_IMPORTS = ("jax",)
 AUTO_STATICS = {
     "block_perm", "frontier_mode", "frontier_threshold",
     "frontier_algo", "prefetch_depth", "overlap_mode", "hier_mode",
-    "sir_fuse", "serve_chunk",
+    "sir_fuse", "serve_chunk", "realgraph_pack_width",
+    "realgraph_scatter",
 }
 
 # ---------------------------------------------------------------------

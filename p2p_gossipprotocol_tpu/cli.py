@@ -51,13 +51,22 @@ def build_parser() -> argparse.ArgumentParser:
                    default=None,
                    help="jax mode: overlay model override (same as the "
                         "graph= config key)")
-    p.add_argument("--engine", choices=["edges", "aligned", "fleet"],
+    p.add_argument("--engine",
+                   choices=["edges", "aligned", "fleet", "realgraph"],
                    default=None,
                    help="jax mode: exact edge-list engine, the "
-                        "hardware-aligned pallas engine (1M+ peers), or "
+                        "hardware-aligned pallas engine (1M+ peers), "
                         "the fleet engine (batched multi-scenario "
-                        "sweeps — needs --sweep); default: the "
-                        "config's engine= key (edges)")
+                        "sweeps — needs --sweep), or the real-graph "
+                        "SpMV engine over an ingested edge list "
+                        "(--graph-file; bitwise == edges); default: "
+                        "the config's engine= key (edges)")
+    p.add_argument("--graph-file", default=None, metavar="PATH",
+                   help="jax mode, engine=realgraph: edge-list file "
+                        "(whitespace/CSV/SNAP — sniffed) or a prebuilt "
+                        ".csr artifact directory; same as the "
+                        "graph_file= config key.  First ingest caches "
+                        "a CRC-verified CSR artifact next to the file")
     p.add_argument("--sweep", default=None, metavar="SPECS",
                    help="jax mode: serve a batched multi-scenario sweep "
                         "(engine=fleet): SPECS is a JSONL file, one "
@@ -287,6 +296,15 @@ def _run_jax(cfg: NetworkConfig, args) -> int:
                   f"{sim.topo.n_slots} slots/peer, "
                   f"churn={cfg.churn_rate:g}, "
                   f"byzantine={cfg.byzantine_fraction:g}, "
+                  f"engine={engine}")
+        elif engine == "realgraph":
+            pk = sim._pack
+            print(f"[jax/realgraph] simulating {n} peers, "
+                  f"{sim.n_msgs} messages, mode={sim.mode}, "
+                  f"{pk.n_edges} edges in {len(pk.blocks)} "
+                  f"degree-class blocks (width cap {pk.width_cap}), "
+                  f"delivery={'scatter' if sim._scatter else 'gather'}, "
+                  f"graph={cfg.graph_file or cfg.graph}, "
                   f"engine={engine}")
         else:
             print(f"[jax] simulating {n} peers, "
@@ -832,6 +850,13 @@ def main(argv: list[str] | None = None) -> int:
         cfg.wire_format = args.wire_format
     if args.engine:
         cfg.engine = args.engine
+    if args.graph_file:
+        # --graph-file implies the realgraph engine unless a flag or
+        # config key already picked one that consumes it (the fleet
+        # spec layer routes graph_file lines to realgraph itself)
+        cfg.graph_file = args.graph_file
+        if not args.engine and cfg.engine == "edges":
+            cfg.engine = "realgraph"
     if args.sweep:
         # --sweep implies the fleet engine: the spec file IS the sweep
         cfg.sweep_file = args.sweep

@@ -162,6 +162,12 @@ _SIM_INT_KEYS = {
     # into the gossip kernel's stream (one stream instead of the
     # permute prep + solo count_pass pair) — -1 auto / 0 / 1.
     "sir_fuse": "sir_fuse",
+    # realgraph engine: pack-width cap (power of two) for the degree-
+    # bucketed SpMV blocks, and the gather/scatter delivery choice —
+    # both -1 = AUTO via the tuning chokepoint; both bitwise-safe
+    # (they pick HOW the same boolean OR executes).
+    "realgraph_pack_width": "realgraph_pack_width",
+    "realgraph_scatter": "realgraph_scatter",
     "rounds": "rounds",
     "prng_seed": "prng_seed",
     # jax backend: rounds between successive message activations —
@@ -337,6 +343,11 @@ _SIM_STR_KEYS = {
     # the CLI alike, so a reference-parity deployment can opt into the
     # scale path without leaving the config file.
     "engine": "engine",
+    # Real-graph engine (engine=realgraph): path to an on-disk edge
+    # list (whitespace/CSV/SNAP) or a prebuilt .csr artifact directory,
+    # plus the parser to use (auto sniffs on the first chunk).
+    "graph_file": "graph_file",
+    "realgraph_format": "realgraph_format",
     # Fault plane schedules: partition windows "start:heal[+start:heal]"
     # and crash/recover schedules "round:fraction[+round:fraction]".
     "fault_partition": "fault_partition",
@@ -387,6 +398,21 @@ class NetworkConfig:
         self.wire_format = "json"      # json (reference-compat) | framed
         self.mode = "push"
         self.engine = "edges"          # edges | aligned (jax backend)
+        # Real-graph engine (engine=realgraph; realgraph/): ingest an
+        # on-disk edge list (or a prebuilt .csr artifact directory)
+        # instead of a synthetic graph model.  graph_file set +
+        # engine=realgraph routes one gossip round through the
+        # degree-bucketed masked-SpMV delivery, bitwise-identical to
+        # engine=edges on the same topology (docs/PARITY.md).
+        self.graph_file = ""             # edge list / artifact dir
+        self.realgraph_format = "auto"   # auto | ws | csv | snap
+        # SpMV pack width cap (power of two) and gather/scatter
+        # delivery choice — both -1 = AUTO via the tuning chokepoint
+        # (cache hit wins, else the resolver heuristics; both pick HOW
+        # the same boolean OR is computed, so they are bitwise-safe
+        # and therefore tunable — tuning/resolve.py).
+        self.realgraph_pack_width = -1
+        self.realgraph_scatter = -1
         self.n_peers = 0
         self.n_messages = 0
         self.avg_degree = 8
@@ -807,8 +833,19 @@ class NetworkConfig:
             raise ConfigError(f"Unknown wire_format: {self.wire_format}")
         if self.mode not in ("push", "pull", "pushpull", "sir"):
             raise ConfigError(f"Unknown gossip mode: {self.mode}")
-        if self.engine not in ("edges", "aligned", "fleet"):
+        if self.engine not in ("edges", "aligned", "fleet", "realgraph"):
             raise ConfigError(f"Unknown engine: {self.engine}")
+        if self.realgraph_format not in ("auto", "ws", "csv", "snap"):
+            raise ConfigError(
+                f"Unknown realgraph_format: {self.realgraph_format}")
+        w = self.realgraph_pack_width
+        if w != -1 and (w < 1 or w > 4096 or (w & (w - 1))):
+            raise ConfigError(
+                "realgraph_pack_width must be -1 (auto) or a power of "
+                f"two in [1, 4096], got {w}")
+        if self.realgraph_scatter not in (-1, 0, 1):
+            raise ConfigError(
+                "realgraph_scatter must be -1 (auto), 0, or 1")
         if not (0.0 <= self.sweep_target < 1.0):
             raise ConfigError("sweep_target must be in [0, 1)")
         for k in ("sir_beta", "sir_gamma"):

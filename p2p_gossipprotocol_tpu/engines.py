@@ -23,6 +23,12 @@ aligned    gossip N             0/1         parallel.AlignedShardedSimulator
 aligned    gossip N             M | N       parallel.Aligned2DShardedSimulator
 aligned    sir    0/1           —           aligned_sir.AlignedSIRSimulator
 aligned    sir    N             —           parallel.AlignedShardedSIRSimulator
+realgraph  gossip 0/1           —           realgraph.RealGraphSimulator
+                                            (ingested edge-list graphs via
+                                            graph_file=; bitwise == edges
+                                            on the same topology)
+realgraph  sir    0/1           —           sim.SIRSimulator over the
+                                            ingested topology
 fleet      gossip 0/1           —           fleet.FleetSweep (batched
                                             multi-scenario serving; needs a
                                             sweep spec — sweep_file= or the
@@ -208,6 +214,13 @@ def config_keys(cfg, n_peers: int | None = None) -> dict:
         "mode": cfg.mode,
         "graph": cfg.graph,
         "graph_backend": cfg.graph_backend,
+        # realgraph: WHICH graph was ingested is trajectory-determining
+        # (the artifact's own CRC fingerprint additionally guards the
+        # content — realgraph.ingest.artifact_fingerprint); the pack
+        # width / scatter knobs are deliberately absent, bitwise-safe
+        # execution choices like the frontier_* family.
+        "graph_file": cfg.graph_file,
+        "realgraph_format": cfg.realgraph_format,
         "avg_degree": cfg.avg_degree,
         "ba_m": cfg.ba_m,
         "er_p": cfg.er_p,
@@ -330,6 +343,28 @@ def _build_simulator(cfg, *, n_peers, mesh_devices, msg_shards, clamps):
             raise ValueError(
                 f"msg_shards ({msg_shards}) must divide mesh_devices "
                 f"({n_shards})")
+
+    if cfg.engine == "realgraph":
+        # Ingested-graph engine (realgraph/): single-device by design
+        # today — the pack tables ride the jit as closure constants;
+        # the sharded seam (realgraph.pack.shard_partition + the PR
+        # 5/14 frontier exchange) is documented, not built.
+        if n_shards > 1 or msg_shards > 1:
+            raise ValueError(
+                "engine=realgraph is single-device (the sharded seam — "
+                "realgraph.pack.shard_partition over the frontier "
+                "delta exchange — is documented, not built); drop "
+                "mesh_devices/msg_shards or use engine=aligned")
+        if cfg.mode == "sir":
+            from p2p_gossipprotocol_tpu.realgraph.engine import \
+                sir_from_config
+
+            return sir_from_config(cfg, n_peers=n_peers), "realgraph"
+        from p2p_gossipprotocol_tpu.realgraph import RealGraphSimulator
+
+        sim = RealGraphSimulator.from_config(cfg, n_peers=n_peers,
+                                             clamps=clamps)
+        return sim, "realgraph"
 
     if cfg.mode == "sir":
         if cfg.engine == "aligned":

@@ -33,8 +33,8 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from p2p_gossipprotocol_tpu.config import ConfigError
-from p2p_gossipprotocol_tpu.fleet.engine import (METRIC_KEYS, FleetBucket,
-                                                 stack_topologies)
+from p2p_gossipprotocol_tpu.fleet.engine import (FleetBucket,
+                                                 bucket_class_for)
 from p2p_gossipprotocol_tpu.fleet.packer import pack
 from p2p_gossipprotocol_tpu.fleet.spec import (ScenarioSpec,
                                                build_scenarios,
@@ -44,9 +44,6 @@ from p2p_gossipprotocol_tpu.fleet.spec import (ScenarioSpec,
 #: the artifacts differ; the fingerprint/atomic-write/CRC machinery is
 #: shared from utils.checkpoint).
 SWEEP_SCHEMA = 1
-
-_STATE_LEAVES = ("seen_w", "frontier_w", "alive_b", "byz_w", "key",
-                 "round")
 
 
 def append_rows(path: str, rows: list[dict]) -> None:
@@ -148,8 +145,11 @@ class FleetSweep:
 
     def _bucket(self, b: int) -> FleetBucket:
         if b not in self._sim_cache:
-            self._sim_cache[b] = FleetBucket(
-                [self.scenarios[i].sim for i in self.buckets[b]])
+            sims = [self.scenarios[i].sim for i in self.buckets[b]]
+            # engine-aware: realgraph sims carry their own bucket class
+            # (fleet.engine.bucket_class_for); signature packing already
+            # guarantees a bucket never mixes engines
+            self._sim_cache[b] = bucket_class_for(sims[0])(sims)
         return self._sim_cache[b]
 
     # -- per-bucket rows ------------------------------------------------
@@ -191,12 +191,9 @@ class FleetSweep:
         from p2p_gossipprotocol_tpu.utils.checkpoint import (_crc_entry,
                                                              _write_atomic)
 
-        payload = {f"state/{k}": np.asarray(
-            jax.device_get(getattr(state, k))) for k in _STATE_LEAVES}
-        if state.strikes is not None:
-            payload["state/strikes"] = np.asarray(
-                jax.device_get(state.strikes))
-        payload["topo/colidx"] = np.asarray(jax.device_get(topo.colidx))
+        bucket = self._bucket(b)
+        payload = {k: np.asarray(jax.device_get(v)) for k, v in
+                   bucket.persist_arrays(state, topo).items()}
         payload["mask/done"] = np.asarray(jax.device_get(done))
         for k, v in hist.items():
             payload[f"hist/{k}"] = np.asarray(v)
@@ -206,6 +203,7 @@ class FleetSweep:
         os.replace(tmp, path)
         manifest["buckets"][str(b)] = {
             "status": "partial", "rounds_done": int(rounds_done),
+            "kind": bucket.persist_kind,
             "leaves": {k: _crc_entry(v) for k, v in payload.items()},
         }
         _write_atomic(self._manifest_path(directory),
@@ -216,7 +214,6 @@ class FleetSweep:
         CRC-verified; raises CorruptCheckpoint naming the bad leaf."""
         import jax.numpy as jnp
 
-        from p2p_gossipprotocol_tpu.aligned import AlignedState
         from p2p_gossipprotocol_tpu.utils.checkpoint import (
             CorruptCheckpoint, _crc_entry)
 
@@ -239,19 +236,21 @@ class FleetSweep:
                 raise CorruptCheckpoint(
                     f"CRC mismatch in fleet bucket {b} leaf {name!r}")
         bucket = self._bucket(b)
-        state = AlignedState(
-            **{k: jnp.asarray(payload[f"state/{k}"])
-               for k in _STATE_LEAVES},
-            strikes=(jnp.asarray(payload["state/strikes"])
-                     if "state/strikes" in payload else None))
+        kind = entry.get("kind", "aligned")
+        if kind != bucket.persist_kind:
+            raise CorruptCheckpoint(
+                f"fleet bucket {b} snapshot was written by a "
+                f"{kind!r} bucket but the sweep rebuilt a "
+                f"{bucket.persist_kind!r} one — the spec changed "
+                "under the checkpoint")
         # statics + immutable tables rebuild deterministically from the
-        # scenario seeds; only the rewired lane tables carry history
-        topo = stack_topologies(
-            [self.scenarios[i].sim.topo for i in self.buckets[b]],
-            bucket.template.topo).replace(
-                colidx=jnp.asarray(payload["topo/colidx"]))
+        # scenario seeds; only the round-mutable leaves carry history
+        # (the bucket kind knows which — aligned: rewired colidx lanes;
+        # realgraph: dst + edge_mask)
+        state, topo = bucket.restore_arrays(bucket.stack_topos(),
+                                            payload)
         done = jnp.asarray(payload["mask/done"])
-        hist = {k: payload[f"hist/{k}"] for k in METRIC_KEYS}
+        hist = {k: payload[f"hist/{k}"] for k in bucket.metric_keys}
         hist["_converged_round"] = payload["hist/_converged_round"]
         return state, topo, done, hist, int(entry["rounds_done"])
 

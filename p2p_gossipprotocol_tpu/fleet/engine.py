@@ -82,6 +82,14 @@ def _unstack_topology(btopo: AlignedTopology, i: int,
                            reuse_leak=solo.reuse_leak)
 
 
+def bucket_class_for(sim):
+    """The bucket class that batches/serves this simulator kind: a sim
+    may carry its own (``RealGraphSimulator`` sets ``_bucket_class`` —
+    the dispatch stays attribute-based so this module never imports
+    realgraph); the aligned family is the default."""
+    return getattr(sim, "_bucket_class", FleetBucket)
+
+
 def _freeze(done, old, new):
     """Per-leaf select: a done scenario keeps its frozen value."""
     d = done.reshape(done.shape + (1,) * (new.ndim - 1))
@@ -131,6 +139,14 @@ class FleetBucket:
     #: stayed compilation-free (the serving plane's acceptance gate).
     trace_count: int = field(default=0, repr=False)
 
+    #: per-kind metric dtype table (class attributes so engine-specific
+    #: buckets — realgraph — override them; the serving plane and the
+    #: result unpack read them off the bucket, never the module)
+    metric_dtypes = METRIC_DTYPES
+    metric_keys = METRIC_KEYS
+    #: serve-salvage manifest kind tag (per-bucket payload dispatch)
+    persist_kind = "aligned"
+
     def __post_init__(self):
         if not self.sims:
             raise ValueError("a fleet bucket needs at least one scenario")
@@ -147,11 +163,71 @@ class FleetBucket:
         # is off (aligned_round never touches them then)
         if self.template.message_stagger > 0:
             self._srcs = jnp.stack(
-                [s._message_plan()[1] for s in self.sims])
+                [self._srcs_row_of(s) for s in self.sims])
         else:
             self._srcs = jnp.zeros((len(self.sims), 1), jnp.int32)
         self._sched_end = stagger_sched_end(
             self.template._n_honest, self.template.message_stagger)
+
+    # -- per-kind hooks (RealGraphBucket overrides these) ---------------
+    def _srcs_row_of(self, s):
+        """One scenario's staggered message-source row."""
+        return s._message_plan()[1]
+
+    def _one_round(self):
+        """The per-slot round fn the chunk vmaps:
+        ``(state, topo, seed, srcs) -> (state', topo', metrics)``."""
+        tmpl = self.template
+
+        def one(state, topo, seed, srcs):
+            grows = jnp.arange(topo.rows, dtype=jnp.int32)
+            return aligned_round(
+                tmpl, state, topo, grows=grows, t_off=jnp.int32(0),
+                gather=lambda x: x, reduce=lambda x: x,
+                hash_seed=seed, msg_srcs=srcs)
+        return one
+
+    def unstack_topo(self, btopo, i: int, solo_topo):
+        """Slot ``i``'s solo topology slice."""
+        return _unstack_topology(btopo, i, solo_topo)
+
+    def stack_topos(self):
+        """Every scenario's solo topology, stacked along the slot axis
+        (the inverse of :meth:`unstack_topo`; the salvage-restore path
+        rebuilds statics through this before overlaying the persisted
+        mutable leaves)."""
+        return stack_topologies([s.topo for s in self.sims],
+                                self.template.topo)
+
+    def persist_arrays(self, bstate, btopo) -> dict:
+        """Every mutable array leaf a serve salvage must persist for
+        this bucket kind, keyed ``state/<leaf>`` / ``topo/<leaf>``
+        (serve/service.py writes them; :meth:`restore_arrays` is the
+        inverse).  For aligned buckets that is the AlignedState leaves
+        (+ optional strikes) and the rewired ``colidx`` lanes."""
+        out = {f"state/{k}": getattr(bstate, k)
+               for k in ("seen_w", "frontier_w", "alive_b", "byz_w",
+                         "key", "round")}
+        if bstate.strikes is not None:
+            out["state/strikes"] = bstate.strikes
+        out["topo/colidx"] = btopo.colidx
+        return out
+
+    def restore_arrays(self, btopo, payload: dict):
+        """Rebuild (bstate, btopo) from a salvage payload dict — the
+        inverse of :meth:`persist_arrays`, against the freshly
+        re-admitted bucket's topology."""
+        from p2p_gossipprotocol_tpu.aligned import AlignedState
+
+        state = AlignedState(
+            **{k: jnp.asarray(payload[f"state/{k}"])
+               for k in ("seen_w", "frontier_w", "alive_b", "byz_w",
+                         "key", "round")},
+            strikes=(jnp.asarray(payload["state/strikes"])
+                     if "state/strikes" in payload else None))
+        btopo = btopo.replace(
+            colidx=jnp.asarray(payload["topo/colidx"]))
+        return state, btopo
 
     @property
     def size(self) -> int:
@@ -163,9 +239,7 @@ class FleetBucket:
         stacked — bit-identical per scenario by construction."""
         bstate = jax.tree.map(lambda *xs: jnp.stack(xs),
                               *[s.init_state() for s in self.sims])
-        btopo = stack_topologies([s.topo for s in self.sims],
-                                 self.template.topo)
-        return bstate, btopo
+        return bstate, self.stack_topos()
 
     # ------------------------------------------------------------------
     @classmethod
@@ -304,17 +378,9 @@ class FleetBucket:
         key = (length, target)
         if key in self._chunk_cache:
             return self._chunk_cache[key]
-        tmpl = self.template
         sched_end = self._sched_end
 
-        def one(state, topo, seed, srcs):
-            grows = jnp.arange(topo.rows, dtype=jnp.int32)
-            return aligned_round(
-                tmpl, state, topo, grows=grows, t_off=jnp.int32(0),
-                gather=lambda x: x, reduce=lambda x: x,
-                hash_seed=seed, msg_srcs=srcs)
-
-        vstep = jax.vmap(one)
+        vstep = jax.vmap(self._one_round())
 
         def chunk(bstate, btopo, done, seeds, srcs):
             # trace-time only: one bump per compilation of this chunk
@@ -378,7 +444,8 @@ class FleetBucket:
         if done is None:
             done = jnp.zeros(B, bool)
         hist = dict(hist) if hist else {
-            k: np.zeros((0, B), dt) for k, dt in METRIC_DTYPES.items()}
+            k: np.zeros((0, B), dt)
+            for k, dt in self.metric_dtypes.items()}
         conv = hist.pop("_converged_round", np.zeros(B, np.int64) - 1)
         conv = np.asarray(conv, np.int64)
         t0 = time.perf_counter()
@@ -403,7 +470,7 @@ class FleetBucket:
                                                   self._seeds,
                                                   self._srcs)
                 ys = {k: np.asarray(jax.device_get(ys[k]))
-                      for k in METRIC_KEYS}
+                      for k in self.metric_keys}
             telemetry.counter_add("fleet_rounds_total", step)
             telemetry.counter_add("fleet_scenario_rounds_total",
                                   step * B)
@@ -430,10 +497,10 @@ class FleetBucket:
         for i, solo in enumerate(self.sims):
             r_i = int(rounds_run[i])
             st_i = jax.tree.map(lambda x: x[i], state)
-            tp_i = _unstack_topology(topo, i, solo.topo)
+            tp_i = self.unstack_topo(topo, i, solo.topo)
             results.append(SimResult(
                 state=st_i, topo=tp_i, wall_s=wall,
-                **{k: hist[k][:r_i, i] for k in METRIC_KEYS}))
+                **{k: hist[k][:r_i, i] for k in self.metric_keys}))
         return BucketResult(results=results, rounds_run=rounds_run,
                             converged=converged, wall_s=wall,
                             interrupted=interrupted)

@@ -51,7 +51,15 @@ def bucket_signature(sim) -> tuple:
     """Hashable identity of the compiled round program for ``sim``
     (an :class:`aligned.AlignedSimulator`).  Two sims with equal
     signatures batch into one bucket; the parity suite asserts the
-    batched trajectories stay bitwise-identical to solo runs."""
+    batched trajectories stay bitwise-identical to solo runs.
+
+    Non-aligned engines that can batch (realgraph) publish their own
+    identity through a ``_bucket_signature`` hook — their first element
+    is the engine name, so cross-engine collisions are impossible and
+    the tuple below stays the aligned family's exhaustive list."""
+    fn = getattr(sim, "_bucket_signature", None)
+    if fn is not None:
+        return fn()
     t = sim.topo
     return (
         # --- array shapes (stacking) ---

@@ -186,7 +186,15 @@ def build_scenarios(base_cfg: NetworkConfig, specs: list[dict],
     scenario inherits when its line doesn't set one.  Scenarios must be
     gossip-mode (push/pull/pushpull) — the fleet batches the aligned
     engine; ``mode=sir`` and ``engine=edges`` scenarios are named
-    errors, not silent substitutions."""
+    errors, not silent substitutions.
+
+    A scenario whose effective config carries ``graph_file`` builds a
+    :class:`realgraph.RealGraphSimulator` instead (``engine`` itself is
+    a reserved key — graph_file IS the realgraph request): the ingested
+    graph fixes the peer count, so no power-of-two padding applies, and
+    the scenario routes into its own signature bucket (the realgraph
+    ``_bucket_signature`` leads with the engine name + graph
+    fingerprint, so it can never collide with an aligned program)."""
     from p2p_gossipprotocol_tpu.aligned import AlignedSimulator
 
     out = []
@@ -197,11 +205,24 @@ def build_scenarios(base_cfg: NetworkConfig, specs: list[dict],
                 f"sweep scenario {i}: the fleet engine batches the "
                 f"aligned gossip engine (push/pull/pushpull), not "
                 f"mode={cfg_i.mode!r}")
+        clamps: list[str] = []
+        if cfg_i.graph_file:
+            from p2p_gossipprotocol_tpu.realgraph import \
+                RealGraphSimulator
+
+            try:
+                sim = RealGraphSimulator.from_config(cfg_i, clamps=clamps)
+            except (ValueError, OSError) as e:
+                raise ConfigError(f"sweep scenario {i}: {e}")
+            n_eff = int(sim.topo.n_peers)
+            out.append(ScenarioSpec(
+                index=i, overrides=dict(overrides), cfg=cfg_i, sim=sim,
+                n_peers=n_eff, n_peers_requested=n_eff, clamps=clamps))
+            continue
         n_req = (int(overrides["n_peers"]) if "n_peers" in overrides
                  else (n_peers or cfg_i.n_peers
                        or len(cfg_i.seed_nodes)))
         n_eff = next_pow2(n_req) if pad_peers else n_req
-        clamps: list[str] = []
         try:
             sim = AlignedSimulator.from_config(cfg_i, n_peers=n_eff,
                                                clamps=clamps)

@@ -72,15 +72,13 @@ class Topology:
     def n_edges(self) -> jax.Array:
         return jnp.sum(self.edge_mask.astype(jnp.int32))
 
-    def to_bcoo(self):
-        """Adjacency as ``jax.experimental.sparse.BCOO`` (float32, n × n)
-        for interop with sparse linear algebra; masked-out edges contribute
-        0.  float32 because that is what the MXU consumes for SpMV."""
-        from jax.experimental import sparse
-
-        idx = jnp.stack([self.src, self.dst], axis=1)
-        return sparse.BCOO((self.edge_mask.astype(jnp.float32), idx),
-                           shape=(self.n_peers, self.n_peers))
+    # ``to_bcoo`` (a float32 jax.experimental.sparse.BCOO view) was
+    # retired in PR 19: the repo's ONE sparse-adjacency representation
+    # is the realgraph engine's degree-bucketed pack
+    # (realgraph.pack.pack_topology) — boolean masked SpMV over these
+    # exact src/dst/edge_mask arrays, bitwise-identical to the edges
+    # engine's scatter.  A dense float view of the adjacency never had
+    # a consumer, and keeping two sparse stories invites drift.
 
 
 def _pad_and_build(n: int, src: np.ndarray, dst: np.ndarray,

@@ -1,0 +1,46 @@
+"""Real-graph sparse engine: gossip as masked SpMV over ingested CSR.
+
+The package closes ROADMAP item 3's real-workload gap: every other
+fast engine simulates the host-built synthetic overlay, but real
+gossip workloads (social graphs, contact networks, web crawls) arrive
+as EDGE LISTS with degree skew no aligned row layout can pad away.
+The pipeline here is the dense-hardware sparse playbook —
+
+  ingest.py   streaming edge-list loader -> canonical CSR artifact
+              (atomic + CRC, the utils/checkpoint.py discipline),
+              plus the seeded RMAT generator benches and tests use;
+  pack.py     degree-bucketed vertex-block packing: power-of-two-width
+              padded blocks with a static pack signature (the fleet
+              packer's compile-reuse discipline applied to vertex
+              blocks) and the 1-D degree-balanced shard partition;
+  engine.py   RealGraphSimulator — the exact edges-engine round with
+              only the delivery SpMV swapped for the packed gather
+              (bitwise-identical by construction; the parity contract
+              is documented on PackedTransport).
+
+``engines.build_simulator`` routes ``engine=realgraph`` here; the
+``graph_file=`` config key selects an ingested artifact (or a raw
+edge-list file, ingested on first use).
+"""
+
+from p2p_gossipprotocol_tpu.realgraph.ingest import (GraphFormatError,
+                                                     ingest_edge_list,
+                                                     load_artifact,
+                                                     load_graph_file,
+                                                     rmat_edges,
+                                                     write_artifact,
+                                                     write_edge_file)
+from p2p_gossipprotocol_tpu.realgraph.pack import (PackedGraph,
+                                                   pack_signature,
+                                                   pack_topology,
+                                                   shard_partition)
+from p2p_gossipprotocol_tpu.realgraph.engine import (PackedTransport,
+                                                     RealGraphBucket,
+                                                     RealGraphSimulator)
+
+__all__ = [
+    "GraphFormatError", "ingest_edge_list", "load_artifact",
+    "load_graph_file", "rmat_edges", "write_artifact", "write_edge_file",
+    "PackedGraph", "pack_signature", "pack_topology", "shard_partition",
+    "PackedTransport", "RealGraphBucket", "RealGraphSimulator",
+]

@@ -1,0 +1,344 @@
+"""Streaming edge-list ingest -> the canonical CSR graph artifact.
+
+Real graphs arrive as text edge lists (whitespace/SNAP or CSV, with
+``#``/``%`` comment lines and an optional CSV header).  The loader
+reads the file in bounded byte chunks — a 100M+-edge file never
+materializes in host RAM as text; peak footprint is the compact int64
+edge arrays themselves — and resolves the edges through
+``graph._pad_and_build``, the SAME canonicalization every jax engine's
+overlay takes (self-loop/out-of-range filter, stable src sort, 1024
+padding).  The artifact is therefore bitwise the topology the edges
+engine would build from the same list, which is what makes the
+realgraph==edges parity contract checkable at all.
+
+On disk an artifact is a directory of ``.npy`` leaves (src, dst,
+edge_mask, row_ptr, deg_in, deg_out) plus ``graph_manifest.json``,
+written tmp+rename LAST with a CRC per leaf — the
+``utils/checkpoint.py`` atomic+CRC discipline, same named errors: a
+torn write leaves the previous manifest (or none) in place, a
+corrupted leaf is a :class:`CorruptCheckpoint` naming the leaf, never
+a silently different graph.
+
+The seeded RMAT generator (:func:`rmat_edges`) gives tests and benches
+power-law graphs with realistic skew at any scale, deterministically.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from p2p_gossipprotocol_tpu.utils.checkpoint import (CheckpointError,
+                                                     CorruptCheckpoint,
+                                                     _crc_entry,
+                                                     _write_atomic,
+                                                     read_manifest)
+
+#: artifact manifest schema (independent of the checkpoint schema —
+#: a graph artifact is immutable input data, not run state)
+ARTIFACT_SCHEMA = 1
+
+#: manifest filename inside an artifact directory
+MANIFEST = "graph_manifest.json"
+
+#: the array leaves an artifact persists, in manifest order
+ARTIFACT_LEAVES = ("src", "dst", "edge_mask", "row_ptr", "deg_in",
+                   "deg_out")
+
+#: default streaming read size (32 MiB of text per chunk)
+CHUNK_BYTES = 32 << 20
+
+
+class GraphFormatError(CheckpointError):
+    """An edge-list file the parser cannot read, with the line number
+    and the offending text — a malformed line is a named error at
+    ingest, never a silently dropped edge."""
+
+
+# ---------------------------------------------------------------------
+# Streaming text parsing.
+
+def _detect_format(line: str) -> str:
+    return "csv" if "," in line else "ws"
+
+
+def _parse_chunk(text: str, fmt: str, lineno0: int, first: list
+                 ) -> np.ndarray:
+    """Parse one decoded chunk into an int64 ``[k, 2]`` edge array.
+    ``first`` is a one-element mutable flag: the first data line of a
+    CSV file may be a header and is skipped on parse failure (once)."""
+    rows: list = []
+    sep = "," if fmt == "csv" else None
+    for off, raw in enumerate(text.split("\n")):
+        line = raw.strip()
+        if not line or line[0] in "#%":
+            continue
+        parts = line.split(sep)
+        if len(parts) < 2:
+            raise GraphFormatError(
+                f"edge-list line {lineno0 + off + 1}: expected "
+                f"'src dst', got {line!r}")
+        try:
+            rows.append((int(parts[0]), int(parts[1])))
+        except ValueError:
+            if first[0]:
+                first[0] = False     # a CSV header line, once
+                continue
+            raise GraphFormatError(
+                f"edge-list line {lineno0 + off + 1}: non-integer "
+                f"endpoint in {line!r}")
+        first[0] = False
+    if not rows:
+        return np.zeros((0, 2), np.int64)
+    return np.asarray(rows, np.int64)
+
+
+def iter_edge_chunks(path: str, fmt: str = "auto",
+                     chunk_bytes: int = CHUNK_BYTES):
+    """Yield ``int64[k, 2]`` edge arrays from a text edge list, reading
+    at most ``chunk_bytes`` of file at a time.  ``fmt``: ``ws`` /
+    ``snap`` (whitespace columns, ``#``/``%`` comments — SNAP is the
+    whitespace dialect), ``csv``, or ``auto`` (sniffed from the first
+    data line)."""
+    if fmt not in ("auto", "ws", "csv", "snap"):
+        raise GraphFormatError(
+            f"unknown edge-list format {fmt!r} (auto/ws/csv/snap)")
+    eff = "ws" if fmt == "snap" else fmt
+    first = [True]
+    lineno = 0
+    rem = b""
+    try:
+        fp = open(path, "rb")
+    except OSError as e:
+        raise GraphFormatError(f"unable to open edge list {path!r} "
+                               f"({e})") from e
+    with fp:
+        while True:
+            buf = fp.read(chunk_bytes)
+            if not buf:
+                break
+            buf = rem + buf
+            nl = buf.rfind(b"\n")
+            if nl < 0:
+                rem = buf
+                continue
+            text, rem = buf[:nl], buf[nl + 1:]
+            decoded = text.decode("utf-8", errors="strict")
+            if eff == "auto":
+                probe = next((ln for ln in decoded.split("\n")
+                              if ln.strip() and ln.strip()[0]
+                              not in "#%"), None)
+                if probe is not None:
+                    eff = _detect_format(probe)
+            if eff != "auto":
+                yield _parse_chunk(decoded, eff, lineno, first)
+            lineno += decoded.count("\n") + 1
+        if rem:
+            decoded = rem.decode("utf-8", errors="strict")
+            if eff == "auto":
+                eff = _detect_format(decoded)
+            yield _parse_chunk(decoded, eff, lineno, first)
+
+
+# ---------------------------------------------------------------------
+# RMAT generator (seeded, vectorized — power-law degree skew).
+
+def rmat_edges(n_log2: int, n_edges: int, seed: int = 0,
+               a: float = 0.57, b: float = 0.19, c: float = 0.19
+               ) -> tuple[np.ndarray, np.ndarray]:
+    """Seeded R-MAT edge sample: ``(src, dst)`` int64 arrays over
+    ``2**n_log2`` vertices.  The classic recursive-quadrant draw,
+    fully vectorized (one ``[n_edges]`` quadrant draw per bit level),
+    with its own Generator so the sample is a pure function of
+    ``(seed, n_log2, n_edges, a, b, c)`` — the determinism tests and
+    the A/B bench both depend on that."""
+    if not 0.0 < a + b + c < 1.0:
+        raise ValueError("rmat partition probabilities must sum < 1")
+    rng = np.random.default_rng(np.random.SeedSequence([0x524D4154, seed]))
+    src = np.zeros(n_edges, np.int64)
+    dst = np.zeros(n_edges, np.int64)
+    for level in range(n_log2):
+        u = rng.random(n_edges)
+        bit_s = (u >= a + b).astype(np.int64)
+        bit_d = ((u >= a) & (u < a + b) | (u >= a + b + c)).astype(
+            np.int64)
+        src = (src << 1) | bit_s
+        dst = (dst << 1) | bit_d
+    return src, dst
+
+
+def write_edge_file(path: str, src: np.ndarray, dst: np.ndarray,
+                    fmt: str = "ws") -> None:
+    """Write an edge array pair as a text edge list (the bench's
+    ingest-path fixture writer; tmp+rename so a torn write never
+    leaves a half graph behind)."""
+    sep = "," if fmt == "csv" else "\t"
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fp:
+        fp.write("# realgraph edge list\n")
+        for s, d in zip(np.asarray(src).tolist(),
+                        np.asarray(dst).tolist()):
+            fp.write(f"{s}{sep}{d}\n")
+    os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------
+# Artifact write / load.
+
+def _canonical_arrays(n: int, src: np.ndarray, dst: np.ndarray):
+    """The canonical CSR arrays for an edge list: ``_pad_and_build``'s
+    exact output (THE one canonicalization every engine shares) plus
+    the per-vertex structural degrees."""
+    from p2p_gossipprotocol_tpu import graph as graph_lib
+
+    topo = graph_lib._pad_and_build(n, np.asarray(src, np.int64),
+                                    np.asarray(dst, np.int64))
+    mask = np.asarray(topo.edge_mask)
+    e = int(mask.sum())
+    arrays = {
+        "src": np.asarray(topo.src),
+        "dst": np.asarray(topo.dst),
+        "edge_mask": mask,
+        "row_ptr": np.asarray(topo.row_ptr),
+        "deg_out": np.diff(np.asarray(topo.row_ptr)).astype(np.int32),
+        "deg_in": np.bincount(np.asarray(topo.dst)[:e][mask[:e]],
+                              minlength=n).astype(np.int32),
+    }
+    return topo, arrays, e
+
+
+def write_artifact(directory: str, n: int, src: np.ndarray,
+                   dst: np.ndarray, source: dict | None = None) -> dict:
+    """Canonicalize one edge list and persist it as a CSR artifact:
+    every leaf as ``.npy`` (tmp+rename), then the CRC-carrying manifest
+    LAST — the commit point.  Returns the manifest dict."""
+    _topo, arrays, e = _canonical_arrays(n, src, dst)
+    os.makedirs(directory, exist_ok=True)
+    leaves = {}
+    for name in ARTIFACT_LEAVES:
+        arr = arrays[name]
+        tmp = os.path.join(directory, f".{name}.npy.tmp")
+        with open(tmp, "wb") as fp:
+            np.save(fp, arr)
+        os.replace(tmp, os.path.join(directory, f"{name}.npy"))
+        leaves[name] = _crc_entry(arr)
+    manifest = {
+        "schema": ARTIFACT_SCHEMA,
+        "kind": "graph-csr",
+        "n_peers": int(n),
+        "n_edges": int(e),
+        "edge_capacity": int(arrays["src"].shape[0]),
+        "leaves": leaves,
+        "source": dict(source or {}),
+    }
+    _write_atomic(os.path.join(directory, MANIFEST),
+                  json.dumps(manifest, indent=1, sort_keys=True))
+    return manifest
+
+
+def ingest_edge_list(path: str, directory: str, fmt: str = "auto",
+                     n: int | None = None,
+                     chunk_bytes: int = CHUNK_BYTES) -> dict:
+    """Stream-parse a text edge list and write its CSR artifact.
+    ``n`` fixes the vertex count (ids must be ``< n``); default is
+    ``max id + 1``.  Returns the manifest."""
+    chunks = [ch for ch in iter_edge_chunks(path, fmt=fmt,
+                                            chunk_bytes=chunk_bytes)
+              if ch.shape[0]]
+    if not chunks:
+        raise GraphFormatError(f"edge list {path!r} holds no edges")
+    src = np.concatenate([c[:, 0] for c in chunks])
+    dst = np.concatenate([c[:, 1] for c in chunks])
+    del chunks
+    if n is None:
+        n = int(max(src.max(), dst.max())) + 1
+    try:
+        st = os.stat(path)
+        source = {"path": os.path.abspath(path), "format": fmt,
+                  "size": st.st_size, "mtime_ns": st.st_mtime_ns}
+    except OSError:
+        source = {"path": os.path.abspath(path), "format": fmt}
+    return write_artifact(directory, n, src, dst, source=source)
+
+
+def artifact_fingerprint(manifest: dict) -> str:
+    """The graph's identity for bucket signatures and checkpoint
+    fingerprints: a stable hash over the manifest's per-leaf CRCs and
+    shape — the ARRAYS' identity, not the path they came from."""
+    from p2p_gossipprotocol_tpu.utils.checkpoint import config_fingerprint
+
+    return config_fingerprint({"graph_leaves": manifest["leaves"],
+                               "n_peers": manifest["n_peers"],
+                               "n_edges": manifest["n_edges"]})
+
+
+def load_artifact(directory: str):
+    """Load + CRC-verify a CSR artifact.  Returns
+    ``(topology, fingerprint, manifest)`` with jnp-array leaves.
+    Named errors only (the checkpoint discipline): missing manifest ->
+    :class:`CheckpointError`, unreadable/torn manifest or a leaf whose
+    bytes fail its CRC -> :class:`CorruptCheckpoint` naming the leaf."""
+    import jax.numpy as jnp
+
+    from p2p_gossipprotocol_tpu.graph import Topology
+
+    manifest = read_manifest(os.path.join(directory, MANIFEST),
+                             schema_max=ARTIFACT_SCHEMA,
+                             what="graph artifact")
+    if manifest.get("kind") != "graph-csr":
+        raise CorruptCheckpoint(
+            f"{directory!r} manifest is not a graph-csr artifact "
+            f"(kind={manifest.get('kind')!r})")
+    arrays = {}
+    for name in ARTIFACT_LEAVES:
+        leaf_path = os.path.join(directory, f"{name}.npy")
+        entry = manifest["leaves"].get(name)
+        if entry is None or not os.path.exists(leaf_path):
+            raise CorruptCheckpoint(
+                f"graph artifact {directory!r} is missing leaf "
+                f"{name!r} — torn write or deleted file")
+        arr = np.load(leaf_path)
+        got = _crc_entry(arr)
+        if got["crc32"] != entry["crc32"]:
+            raise CorruptCheckpoint(
+                f"graph artifact leaf {name!r} fails its CRC "
+                f"(manifest {entry['crc32']:#x}, file "
+                f"{got['crc32']:#x}) — the artifact cannot be trusted")
+        arrays[name] = arr
+    topo = Topology(
+        src=jnp.asarray(arrays["src"], jnp.int32),
+        dst=jnp.asarray(arrays["dst"], jnp.int32),
+        edge_mask=jnp.asarray(arrays["edge_mask"], bool),
+        row_ptr=jnp.asarray(arrays["row_ptr"], jnp.int32),
+        n_peers=int(manifest["n_peers"]))
+    return topo, artifact_fingerprint(manifest), manifest
+
+
+def load_graph_file(path: str, fmt: str = "auto"):
+    """The ``graph_file=`` entry point: an artifact DIRECTORY loads
+    directly; a raw edge-list FILE ingests into ``<path>.csr/`` next to
+    it (reused on later runs while the source file's size+mtime match
+    the recorded ones, re-ingested otherwise — a changed input is a
+    re-ingest, never a stale graph).  Returns
+    ``(topology, fingerprint, manifest)``."""
+    if os.path.isdir(path):
+        return load_artifact(path)
+    if not os.path.exists(path):
+        raise GraphFormatError(
+            f"graph_file {path!r} does not exist (expected an edge-list "
+            "file or an ingested artifact directory)")
+    cache = path + ".csr"
+    if os.path.exists(os.path.join(cache, MANIFEST)):
+        try:
+            topo, fp, manifest = load_artifact(cache)
+            st = os.stat(path)
+            src_meta = manifest.get("source", {})
+            if (src_meta.get("size") == st.st_size
+                    and src_meta.get("mtime_ns") == st.st_mtime_ns):
+                return topo, fp, manifest
+        except CheckpointError:
+            pass                      # corrupt/stale cache: re-ingest
+    ingest_edge_list(path, cache, fmt=fmt)
+    return load_artifact(cache)

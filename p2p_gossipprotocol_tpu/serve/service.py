@@ -53,9 +53,8 @@ import jax
 import jax.numpy as jnp
 
 from p2p_gossipprotocol_tpu import telemetry
-from p2p_gossipprotocol_tpu.fleet.engine import (METRIC_DTYPES,
-                                                 METRIC_KEYS, FleetBucket,
-                                                 _unstack_topology)
+from p2p_gossipprotocol_tpu.fleet.engine import (METRIC_KEYS, FleetBucket,
+                                                 bucket_class_for)
 from p2p_gossipprotocol_tpu.serve.scheduler import (DONE, FAILED, QUEUED,
                                                     RUNNING, Request,
                                                     Scheduler, ServeReject,
@@ -65,9 +64,6 @@ from p2p_gossipprotocol_tpu.serve.scheduler import (DONE, FAILED, QUEUED,
 #: serve manifest schema (the sweep manifest's sibling; fingerprint /
 #: atomic-write / CRC machinery shared from utils.checkpoint)
 SERVE_SCHEMA = 1
-
-_STATE_LEAVES = ("seen_w", "frontier_w", "alive_b", "byz_w", "key",
-                 "round")
 
 
 @dataclass
@@ -133,8 +129,12 @@ class ServeBucket:
 
     def _fleet_for(self, slots: int) -> FleetBucket:
         if slots not in self._fleets:
-            self._fleets[slots] = FleetBucket.for_serving(
-                self.template_spec.sim, slots)
+            # engine-aware: realgraph sims carry their own bucket class
+            # (fleet.engine.bucket_class_for) — the serving machinery
+            # reads everything kind-specific off the bucket's hooks
+            self._fleets[slots] = bucket_class_for(
+                self.template_spec.sim).for_serving(
+                    self.template_spec.sim, slots)
         return self._fleets[slots]
 
     # ------------------------------------------------------------------
@@ -308,13 +308,14 @@ class ServeBucket:
         from p2p_gossipprotocol_tpu.sim import SimResult
 
         step = self.chunk if step is None else step
-        ys = {k: np.asarray(jax.device_get(ys[k])) for k in METRIC_KEYS}
+        ys = {k: np.asarray(jax.device_get(ys[k]))
+              for k in self.fleet.metric_keys}
         dh = np.asarray(jax.device_get(dhist))
         retired = []
         for s, occ in enumerate(self.occupants):
             if occ is None:
                 continue
-            for k in METRIC_KEYS:
+            for k in self.fleet.metric_keys:
                 occ.hist[k].append(ys[k][:, s])
             if occ.converged < 0:
                 hits = np.nonzero(dh[:, s])[0]
@@ -337,9 +338,11 @@ class ServeBucket:
 
         r_i = occ.converged if occ.converged > 0 else occ.rounds
         st_i = jax.tree.map(lambda x: x[slot], self.state)
-        tp_i = _unstack_topology(self.topo, slot, occ.spec.sim.topo)
+        tp_i = self.fleet.unstack_topo(self.topo, slot,
+                                       occ.spec.sim.topo)
         hist = {k: np.concatenate(occ.hist[k])[:r_i].astype(
-            METRIC_DTYPES[k], copy=False) for k in METRIC_KEYS}
+            self.fleet.metric_dtypes[k], copy=False)
+            for k in self.fleet.metric_keys}
         wall = time.perf_counter() - (occ.req.t_admit
                                       or occ.req.t_enqueue)
         return SimResult(state=st_i, topo=tp_i, wall_s=wall, **hist)
@@ -1144,14 +1147,8 @@ class GossipService:
         for bi, b in enumerate(self.buckets):
             if not b.live():
                 continue
-            payload = {f"state/{k}": np.asarray(
-                jax.device_get(getattr(b.state, k)))
-                for k in _STATE_LEAVES}
-            if b.state.strikes is not None:
-                payload["state/strikes"] = np.asarray(
-                    jax.device_get(b.state.strikes))
-            payload["topo/colidx"] = np.asarray(
-                jax.device_get(b.topo.colidx))
+            payload = {k: np.asarray(jax.device_get(v)) for k, v in
+                       b.fleet.persist_arrays(b.state, b.topo).items()}
             payload["mask/done"] = np.asarray(jax.device_get(b.done))
             occs = {}
             for s, occ in enumerate(b.occupants):
@@ -1161,17 +1158,18 @@ class GossipService:
                                 "overrides": occ.req.overrides,
                                 "rounds": occ.rounds,
                                 "converged": occ.converged}
-                for k in METRIC_KEYS:
+                for k in b.fleet.metric_keys:
                     payload[f"hist/{s}/{k}"] = (
                         np.concatenate(occ.hist[k])
                         if occ.hist[k]
-                        else np.zeros((0,), METRIC_DTYPES[k]))
+                        else np.zeros((0,), b.fleet.metric_dtypes[k]))
             path = self._bucket_path(len(manifest["buckets"]))
             tmp = path + ".tmp.npz"
             np.savez(tmp, **payload)
             os.replace(tmp, path)
             manifest["buckets"].append({
                 "slots": b.slots,
+                "kind": b.fleet.persist_kind,
                 "template": b.template_spec.overrides,
                 "occupants": occs,
                 "leaves": {k: _crc_entry(v)
@@ -1229,8 +1227,6 @@ class GossipService:
             req.row = row
             req.done_event.set()
             self.scheduler.requests[int(rid_s)] = req
-        from p2p_gossipprotocol_tpu.aligned import AlignedState
-
         for bi, entry in enumerate(manifest.get("buckets", [])):
             path = self._bucket_path(bi)
             try:
@@ -1275,18 +1271,21 @@ class GossipService:
                 occ = b.occupants[slot]
                 occ.rounds = int(occ_e["rounds"])
                 occ.converged = int(occ_e["converged"])
-                for k in METRIC_KEYS:
+                for k in b.fleet.metric_keys:
                     h = payload[f"hist/{slot}/{k}"]
                     occ.hist[k] = [h] if len(h) else []
+            kind = entry.get("kind", "aligned")
+            if kind != b.fleet.persist_kind:
+                raise CorruptCheckpoint(
+                    f"serve bucket {bi} snapshot was written by a "
+                    f"{kind!r} bucket but the template re-resolved as "
+                    f"{b.fleet.persist_kind!r} — the base config "
+                    "changed under the checkpoint")
             # the snapshot's mutated arrays win over the re-admitted
-            # init worlds: state leaves wholesale, rewired lanes, done
-            b.state = AlignedState(
-                **{k: jnp.asarray(payload[f"state/{k}"])
-                   for k in _STATE_LEAVES},
-                strikes=(jnp.asarray(payload["state/strikes"])
-                         if "state/strikes" in payload else None))
-            b.topo = b.topo.replace(
-                colidx=jnp.asarray(payload["topo/colidx"]))
+            # init worlds: state leaves wholesale, mutated topology
+            # lanes (aligned: rewired colidx; realgraph: dst +
+            # edge_mask), done
+            b.state, b.topo = b.fleet.restore_arrays(b.topo, payload)
             b.done = jnp.asarray(payload["mask/done"])
             self.buckets.append(b)
         for item in manifest.get("queued", []):

@@ -182,7 +182,8 @@ class Simulator:
                 self.n_msgs, self._n_honest)
         return self._plan_cache
 
-    def _generate_messages(self, state: GossipState) -> GossipState:
+    def _generate_messages(self, state: GossipState,
+                           sources=None) -> GossipState:
         """Staggered generation: on round ``m * k`` inject column m's
         bit at its source peer (the vectorized messageGenerationLoop
         tick, peer.cpp:357-377).  Runs after churn, so a source that
@@ -191,7 +192,10 @@ class Simulator:
         injected frontier bit is relayed THIS round, matching how the
         round-0 seeding is consumed by the first step."""
         k = self.message_stagger
-        sources = self._message_plan()
+        # ``sources`` override: the fleet/serve bucket passes each
+        # slot's own plan row through the vmapped round (the solo path
+        # always reads the cached plan — identical values either way)
+        sources = self._message_plan() if sources is None else sources
         col = jnp.arange(self.n_msgs, dtype=jnp.int32)
         gen = ((col * k == state.round) & (col < self._n_honest)
                & state.alive[sources] & ~state.byzantine[sources])
@@ -200,9 +204,13 @@ class Simulator:
                              frontier=state.frontier | bits)
 
     # ------------------------------------------------------------------
-    def step(self, state: GossipState, topo: Topology
+    def step(self, state: GossipState, topo: Topology, msg_srcs=None
              ) -> tuple[GossipState, Topology, dict]:
-        """One full round: churn → liveness/rewire → (byz inject) → gossip."""
+        """One full round: churn → liveness/rewire → (byz inject) → gossip.
+
+        ``msg_srcs`` (optional) overrides the staggered-generation
+        source row — the batched bucket's per-slot lane; None (every
+        solo path) reads the cached plan."""
         key, k_churn, k_rewire = jax.random.split(state.key, 3)
         state = state.replace(key=key)
         alive = churn_step(k_churn, state.alive, state.round, self.churn)
@@ -225,7 +233,7 @@ class Simulator:
         if self._n_honest < self.n_msgs:
             state = inject_byzantine(state, self._n_honest)
         if self.message_stagger > 0:
-            state = self._generate_messages(state)
+            state = self._generate_messages(state, sources=msg_srcs)
         state, deliveries, redeliveries = self._round_fn(state, topo)
         metrics = {
             "coverage": coverage_of(state, self._n_honest,

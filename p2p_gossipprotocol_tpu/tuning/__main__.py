@@ -71,6 +71,16 @@ def main(argv=None) -> int:
               "— tuning the aligned scale path instead; pass "
               "--engine edges to refuse", file=sys.stderr)
         cfg.engine = "aligned"
+    elif cfg.engine == "realgraph":
+        # realgraph's statics (realgraph_pack_width/realgraph_scatter)
+        # resolve through the tuning chokepoint + cache at build time
+        # — the timed sweep below drives the aligned family only
+        print("[tune] config says engine=realgraph — its statics "
+              "(realgraph_pack_width/realgraph_scatter) resolve "
+              "through the tuning chokepoint at build time; the "
+              "timed sweep tunes the aligned scale path instead; "
+              "pass --engine realgraph to refuse", file=sys.stderr)
+        cfg.engine = "aligned"
 
     from p2p_gossipprotocol_tpu.engines import probe_backend
     from p2p_gossipprotocol_tpu.tuning import search

@@ -57,7 +57,13 @@ from p2p_gossipprotocol_tpu.tuning import cache as tuning_cache
 #: test_serve.py, test_tuning.py).
 TUNABLE = ("frontier_mode", "frontier_threshold", "frontier_algo",
            "prefetch_depth", "overlap_mode", "hier_mode", "sir_fuse",
-           "serve_chunk")
+           "serve_chunk",
+           # the realgraph family (round 19): pack width reshapes the
+           # degree-bucket tables and scatter picks gather-vs-scatter
+           # delivery — both pick HOW the same boolean OR is computed,
+           # never what a round delivers (tests/test_realgraph.py pins
+           # the bitwise side), so both are cache-substitutable
+           "realgraph_pack_width", "realgraph_scatter")
 
 #: signature schema tag — bump when the tuple layout changes so old
 #: cache entries miss instead of misresolving.
@@ -139,6 +145,31 @@ def heuristic_serve_chunk(requested: int) -> int:
     return SERVE_CHUNK_DEFAULT if requested == -1 else int(requested)
 
 
+#: realgraph_pack_width's auto value (realgraph/pack.py derives it:
+#: wide enough for >99% of power-law vertices in one row, narrow
+#: enough that a hub can't widen everyone's lane)
+REALGRAPH_PACK_WIDTH_DEFAULT = 256
+
+
+def heuristic_realgraph_pack_width(requested: int) -> int:
+    """realgraph_pack_width auto rule: -1 = the 256-slot degree-bucket
+    cap the realgraph engine shipped with (hubs beyond it split into
+    multiple rows — semantics-free under the boolean OR)."""
+    return (REALGRAPH_PACK_WIDTH_DEFAULT if requested == -1
+            else int(requested))
+
+
+def heuristic_realgraph_scatter(requested: int,
+                                dst_static: bool) -> int:
+    """realgraph_scatter auto rule: the packed gather (0) whenever the
+    overlay's ``dst`` is static (the gather tables pre-resolve edge
+    ids, so rewiring would stale them — realgraph.engine.dst_is_static
+    is the predicate), the inherited edge scatter (1) otherwise."""
+    if requested in (0, 1):
+        return int(requested)
+    return 0 if dst_static else 1
+
+
 # ---------------------------------------------------------------------
 # Signatures.
 
@@ -181,6 +212,18 @@ def signature_for_sim(sim) -> tuple:
         pull_window=int(bool(getattr(inner, "pull_window", 0))),
         hier=(int(getattr(inner, "hier_hosts", 0) or 0),
               int(getattr(inner, "hier_devs", 0) or 0)))
+
+
+def realgraph_signature(*, n_peers: int, edge_capacity: int, mode: str,
+                        fanout: int, backend: str) -> tuple:
+    """The realgraph family's tuning cache key: graph SHAPE (vertex
+    count x padded edge capacity — the statics the packed tables'
+    program shapes derive from), mode/fanout, backend.  Deliberately
+    NOT the graph's content fingerprint: two same-shape graphs share
+    one best pack width, and per-graph entries would make the cache
+    miss on every fresh ingest."""
+    return (SIG_VERSION, "realgraph", int(n_peers),
+            int(edge_capacity), str(mode), int(fanout), str(backend))
 
 
 def serve_signature(slots: int, rounds: int) -> tuple:

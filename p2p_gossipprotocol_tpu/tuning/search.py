@@ -162,8 +162,12 @@ def tune_config(cfg, n_peers: int | None = None, *, rounds: int = 8,
     if cfg.engine not in ("aligned", "fleet"):
         raise ValueError(
             "the autotuner tunes the aligned engine family's "
-            "performance statics — the edges engine has none (set "
-            "engine=aligned in the config)")
+            "performance statics — the edges engine has none, and the "
+            "realgraph engine's statics (realgraph_pack_width / "
+            "realgraph_scatter) resolve through the tuning chokepoint "
+            "at build time, not through this timed sweep (its run() "
+            "drives the edges-family loop, which the sweep harness "
+            "cannot time) — set engine=aligned in the config")
     # fleet configs tune their underlying aligned scenarios: the
     # bucket batches these exact statics, and the packer signature
     # carries the resolved values, so one solo sweep serves both

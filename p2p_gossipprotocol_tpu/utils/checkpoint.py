@@ -130,6 +130,11 @@ _FAMILIES = {
     "Aligned2DShardedSimulator": "aligned",
     "AlignedSIRSimulator": "aligned-sir",
     "AlignedShardedSIRSimulator": "aligned-sir",
+    # realgraph IS the edges family: identical GossipState/Topology
+    # leaves and the exact Simulator's key schedule (the SpMV only
+    # changes HOW recv is computed), so edges <-> realgraph resume is
+    # bitwise-free in both directions.
+    "RealGraphSimulator": "edges",
 }
 
 #: RNG-schedule identity.  Every aligned engine shares ONE round
@@ -150,6 +155,7 @@ _SCHEDULES = {
     "Aligned2DShardedSimulator": "aligned",
     "AlignedSIRSimulator": "aligned-sir",
     "AlignedShardedSIRSimulator": "aligned-sir",
+    "RealGraphSimulator": "edges-exact",
 }
 
 _ALIGNED_STATE_LEAVES = ("seen_w", "frontier_w", "alive_b", "byz_w",

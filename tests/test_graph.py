@@ -81,14 +81,27 @@ def test_determinism_same_seed():
     assert (np.asarray(a.dst) == np.asarray(b.dst)).all()
 
 
-def test_to_bcoo_matches_edges():
+def test_sparse_adjacency_story_is_realgraph_pack():
+    # to_bcoo was retired in PR 19 — the one sparse-adjacency
+    # representation is the realgraph pack, which must cover exactly
+    # the masked edge set
+    assert not hasattr(G.Topology, "to_bcoo")
+    from p2p_gossipprotocol_tpu.realgraph import pack_topology
+
     t = G.erdos_renyi(5, 50, avg_degree=4)
-    mat = np.asarray(t.to_bcoo().todense()) > 0
+    packed = pack_topology(t)
     src = np.asarray(t.src)[np.asarray(t.edge_mask)]
     dst = np.asarray(t.dst)[np.asarray(t.edge_mask)]
     dense = np.zeros((50, 50), bool)
     dense[src, dst] = True
-    assert (mat == dense).all()
+    got = np.zeros((50, 50), bool)
+    for b in packed.blocks:
+        v = np.asarray(b.vtx)
+        s = np.asarray(b.src)
+        m = np.asarray(b.valid)
+        for r in range(v.shape[0]):
+            got[s[r][m[r]], v[r]] = True
+    assert (got == dense).all()
 
 
 def test_from_config(tmp_path):
